@@ -1,0 +1,89 @@
+"""Config tree semantics (cf. reference tests/test_config.py)."""
+
+import io
+import pickle
+
+import pytest
+
+from veles_tpu.config import Config, apply_overrides, root
+
+
+def test_autovivify_and_assign():
+    cfg = Config("test")
+    cfg.a.b.c = 42
+    assert cfg.a.b.c == 42
+    assert cfg.a.b.get("c") == 42
+    assert "a" in cfg
+
+
+def test_update_deep_merge():
+    cfg = Config("test")
+    cfg.update({"x": {"y": 1, "z": 2}})
+    cfg.update({"x": {"y": 10}})
+    assert cfg.x.y == 10
+    assert cfg.x.z == 2
+
+
+def test_dict_assignment_merges():
+    cfg = Config("test")
+    cfg.node = {"a": 1}
+    cfg.node = {"b": 2}
+    assert cfg.node.a == 1 and cfg.node.b == 2
+
+
+def test_protect():
+    cfg = Config("test")
+    cfg.key = 1
+    cfg.protect("key")
+    with pytest.raises(AttributeError):
+        cfg.key = 2
+    assert cfg.key == 1
+
+
+def test_validate_missing():
+    cfg = Config("test")
+    cfg.present = 5
+    cfg.validate("present")
+    with pytest.raises(AttributeError):
+        cfg.validate("absent")
+
+
+def test_getitem_setitem():
+    cfg = Config("test")
+    cfg["k"] = 3
+    assert cfg["k"] == 3
+
+
+def test_to_dict_roundtrip():
+    cfg = Config("test")
+    cfg.a.b = 1
+    cfg.c = "s"
+    d = cfg.to_dict()
+    assert d == {"a": {"b": 1}, "c": "s"}
+
+
+def test_pickle_roundtrip():
+    cfg = Config("test")
+    cfg.a.b = [1, 2]
+    cfg2 = pickle.loads(pickle.dumps(cfg))
+    assert cfg2.a.b == [1, 2]
+
+
+def test_overrides():
+    apply_overrides(["root.test_override.alpha=0.5",
+                     "test_override.name=hello"])
+    assert root.test_override.alpha == 0.5
+    assert root.test_override.name == "hello"
+
+
+def test_print(capsys=None):
+    cfg = Config("test")
+    cfg.a.b = 1
+    buf = io.StringIO()
+    cfg.print_(file=buf)
+    assert "b: 1" in buf.getvalue()
+
+
+def test_defaults_exist():
+    assert root.common.engine.get("backend") is not None
+    assert root.common.dirs.get("cache")

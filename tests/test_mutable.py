@@ -1,0 +1,118 @@
+"""Bool expression graphs and attribute links (cf. tests/test_mutable.py)."""
+
+import pickle
+
+import pytest
+
+from veles_tpu.mutable import Bool, link, unlink
+
+
+def test_literal_bool():
+    b = Bool()
+    assert not b
+    b <<= True
+    assert b
+    b.value = False
+    assert not b
+
+
+def test_expression_tracks_operands():
+    a, b = Bool(True), Bool(False)
+    expr = a & ~b
+    assert bool(expr)
+    b <<= True
+    assert not bool(expr)
+    a <<= False
+    assert not bool(expr)
+    b <<= False
+    assert not bool(expr)
+    a <<= True
+    assert bool(expr)
+
+
+def test_or_xor():
+    a, b = Bool(False), Bool(False)
+    assert not (a | b)
+    a <<= True
+    assert a | b
+    assert a ^ b
+    b <<= True
+    assert not (a ^ b)
+
+
+def test_derived_refuses_assignment():
+    expr = Bool(True) & Bool(True)
+    with pytest.raises(AttributeError):
+        expr.value = False
+
+
+def test_on_change_callback():
+    b = Bool(False)
+    fired = []
+    b.on_change = fired.append
+    b <<= True
+    b <<= True  # no change, no fire
+    b <<= False
+    assert len(fired) == 2
+
+
+def test_pickle_expression():
+    a, b = Bool(True), Bool(False)
+    expr = a | b
+    expr2 = pickle.loads(pickle.dumps(expr))
+    assert bool(expr2)
+
+
+class Obj(object):
+    pass
+
+
+def test_link_attrs_alias():
+    src, dst = Obj(), Obj()
+    src.x = 10
+    link(dst, "x", src, "x")
+    assert dst.x == 10
+    src.x = 20
+    assert dst.x == 20
+
+
+def test_one_way_write_raises():
+    src, dst = Obj(), Obj()
+    src.x = 1
+    link(dst, "x", src, "x")
+    with pytest.raises(AttributeError):
+        dst.x = 5
+
+
+def test_two_way_write_through():
+    src, dst = Obj(), Obj()
+    src.x = 1
+    link(dst, "x", src, "x", two_way=True)
+    dst.x = 5
+    assert src.x == 5
+    assert dst.x == 5
+
+
+def test_link_renamed_attr():
+    src, dst = Obj(), Obj()
+    src.output = "data"
+    link(dst, "input", src, "output")
+    assert dst.input == "data"
+
+
+def test_unlink_keeps_value():
+    src, dst = Obj(), Obj()
+    src.x = 7
+    link(dst, "x", src, "x")
+    unlink(dst, "x")
+    src.x = 99
+    assert dst.x == 7
+
+
+def test_unlinked_instances_independent():
+    src, a, b = Obj(), Obj(), Obj()
+    src.x = 1
+    link(a, "x", src, "x")
+    b.x = 42  # descriptor now on class; plain instances still work
+    assert b.x == 42
+    assert a.x == 1

@@ -1,0 +1,47 @@
+"""veles-tpu: a TPU-native deep-learning workflow platform.
+
+A from-scratch re-design of the capabilities of Samsung VELES
+(reference: /root/reference, surveyed in SURVEY.md) for TPU hardware:
+the unit/workflow dataflow model survives as the model-description layer,
+while execution lowers whole training steps into single XLA computations
+(jax.jit / pjit over a device mesh), with Pallas kernels for the hot ops.
+
+Public API mirrors the reference's importable launcher
+(``veles/__init__.py:141-189``): ``veles_tpu.run(workflow_cls, config, ...)``.
+"""
+
+__version__ = "0.1.0"
+__license__ = "Apache 2.0"
+
+__root__ = __path__[0].rsplit("/", 1)[0]  # repo root
+
+from veles_tpu.config import root  # noqa: E402,F401
+
+
+def run(workflow_factory, config_update=None, snapshot=None, **kwargs):
+    """Programmatic launcher: build and run a workflow standalone.
+
+    Mirrors the reference's ``veles(workflow, config, **kwargs)`` entry
+    (``veles/__init__.py:141-189``): apply config overrides, construct the
+    workflow under a Launcher, initialize and run it, return the workflow.
+    """
+    try:
+        from veles_tpu.launcher import Launcher
+    except ImportError as exc:
+        raise NotImplementedError(
+            "the launcher subsystem is not available: %s" % exc)
+
+    if config_update:
+        root.update(config_update)
+    launcher = Launcher(**{k: v for k, v in kwargs.items()
+                           if k in Launcher.KWARGS})
+    wf_kwargs = {k: v for k, v in kwargs.items() if k not in Launcher.KWARGS}
+    if snapshot is not None:
+        from veles_tpu.snapshotter import SnapshotterToFile
+        workflow = SnapshotterToFile.import_(snapshot)
+        workflow.workflow = launcher
+    else:
+        workflow = workflow_factory(launcher, **wf_kwargs)
+    launcher.initialize()
+    launcher.run()
+    return workflow

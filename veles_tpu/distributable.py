@@ -1,0 +1,150 @@
+"""Pickleable base + the master/slave distribution protocol.
+
+Re-designs ``veles/distributable.py``. :class:`Pickleable` defines the
+snapshot contract: any attribute whose name ends with ``_`` is transient
+(locks, compiled functions, device handles) and is recreated by
+``init_unpickled()`` after unpickling — this single convention is what
+makes whole-workflow snapshots possible.
+
+:class:`Distributable` adds the five-method data-parallel protocol the
+distributed runtime drives (``veles/distributable.py:136-302``). On TPU
+the *gradient* path lowers to ``lax.psum`` inside the compiled step; this
+protocol survives for what collectives cannot carry: dataset sharding,
+task farming (genetics/ensemble), and elasticity bookkeeping.
+"""
+
+import threading
+
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+
+#: Seconds to wait on the data lock before warning about a possible deadlock
+#: (the reference's DEADLOCK_TIME, ``veles/distributable.py:139-157``).
+DEADLOCK_TIME = 4.0
+
+
+class Pickleable(Logger):
+    """Base class with the ``*_``-is-transient pickling convention."""
+
+    def __init__(self, **kwargs):
+        super(Pickleable, self).__init__(**kwargs)
+        self._method_storage = {}
+        self.init_unpickled()
+
+    def init_unpickled(self):
+        """(Re)create transient state; called from ctor and unpickling."""
+        self.stripped_pickle_ = False
+
+    def __getstate__(self):
+        state = {}
+        for name, value in self.__dict__.items():
+            if name.endswith("_") and not (name.startswith("__") and
+                                           name.endswith("__")):
+                continue
+            if callable(value) and getattr(value, "__self__", None) is self:
+                continue  # bound methods re-bind on init_unpickled
+            state[name] = value
+        return self.pickle_logger_state(state)
+
+    def __setstate__(self, state):
+        super(Pickleable, self).__setstate__(state)
+        self.init_unpickled()
+
+    @property
+    def stripped_pickle(self):
+        """True while pickling for the wire (drop bulk payloads)."""
+        return getattr(self, "stripped_pickle_", False)
+
+    @stripped_pickle.setter
+    def stripped_pickle(self, value):
+        self.stripped_pickle_ = bool(value)
+
+
+class IDistributable(object):
+    """Marker + documentation of the distribution protocol.
+
+    * ``generate_data_for_master()`` → payload sent slave→master after a job
+    * ``generate_data_for_slave(slave)`` → payload sent master→slave as a job
+    * ``apply_data_from_master(data)`` — slave applies a job
+    * ``apply_data_from_slave(data, slave)`` — master merges an update
+    * ``drop_slave(slave)`` — requeue work a dead slave held
+    """
+
+
+class Distributable(Pickleable):
+    """Thread-safe wrappers + ``has_data_for_slave`` event."""
+
+    DEADLOCK_TIME = DEADLOCK_TIME
+
+    def __init__(self, **kwargs):
+        self._generate_data_for_slave_threadsafe = kwargs.pop(
+            "generate_data_for_slave_threadsafe", True)
+        self._apply_data_from_slave_threadsafe = kwargs.pop(
+            "apply_data_from_slave_threadsafe", True)
+        super(Distributable, self).__init__(**kwargs)
+        self.negotiates_on_connect = False
+
+    def init_unpickled(self):
+        super(Distributable, self).init_unpickled()
+        self._data_lock_ = threading.Lock()
+        self._data_event_ = threading.Event()
+        self._data_event_.set()
+
+    @property
+    def has_data_for_slave(self):
+        return self._data_event_.is_set()
+
+    @has_data_for_slave.setter
+    def has_data_for_slave(self, value):
+        if value:
+            self._data_event_.set()
+        else:
+            self._data_event_.clear()
+
+    def wait_for_data_for_slave(self, timeout=DEADLOCK_TIME):
+        if not self._data_event_.wait(timeout):
+            self.warning("wait_for_data_for_slave timed out after %.1fs",
+                         timeout)
+
+    def _locked(self, fn, *args, **kwargs):
+        if not self._data_lock_.acquire(timeout=DEADLOCK_TIME):
+            self.warning("possible deadlock in %s.%s",
+                         type(self).__name__, fn.__name__)
+            self._data_lock_.acquire()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._data_lock_.release()
+
+    # -- protocol defaults (trivially distributable) ----------------------
+
+    def generate_data_for_master(self):
+        return None
+
+    def generate_data_for_slave(self, slave=None):
+        return None
+
+    def apply_data_from_master(self, data):
+        pass
+
+    def apply_data_from_slave(self, data, slave=None):
+        pass
+
+    def drop_slave(self, slave=None):
+        pass
+
+    # -- thread-safe entry points used by the runtime ---------------------
+
+    def generate_data_for_slave_locked(self, slave=None):
+        if self._generate_data_for_slave_threadsafe:
+            return self._locked(self.generate_data_for_slave, slave)
+        return self.generate_data_for_slave(slave)
+
+    def apply_data_from_slave_locked(self, data, slave=None):
+        if self._apply_data_from_slave_threadsafe:
+            return self._locked(self.apply_data_from_slave, data, slave)
+        return self.apply_data_from_slave(data, slave)
+
+
+class TriviallyDistributable(Distributable):
+    """Units with no distributed state at all."""

@@ -1,0 +1,140 @@
+"""Reproducible random number generation.
+
+Re-designs ``veles/prng/random_generator.py``: a registry of named,
+seeded generators (``get(key)``) whose state is part of snapshots, with
+the save/restore discipline that keeps unit initialization from
+perturbing the stream (``veles/units.py:859-885``).
+
+Host-side streams use ``numpy.random.RandomState`` (picklable, stable
+across versions). Device-side randomness uses JAX's counter-based PRNG:
+each generator deterministically derives ``jax`` keys from its seed and a
+split counter, so a restored snapshot continues the *same* key sequence —
+the TPU answer to the reference's xorshift1024* state arrays
+(``veles/prng/uniform.py:49``, ``cuda/random.cu:46-73``).
+"""
+
+import hashlib
+import threading
+
+import numpy
+
+
+class RandomGenerator(object):
+    """One named random stream: numpy host stream + JAX key chain."""
+
+    def __init__(self, key):
+        self.key = key
+        self._lock = threading.Lock()
+        self.seed_value = None
+        self.state = numpy.random.RandomState()
+        self._jax_counter = 0
+
+    def seed(self, seed, dtype=None, count=None):
+        """Seed from an int, bytes, array or a file path ("/dev/urandom").
+
+        ``dtype``/``count`` mirror the reference's file-seeding signature
+        (``veles/__main__.py:483-537``): read ``count`` items of ``dtype``.
+        """
+        if isinstance(seed, str):
+            with open(seed, "rb") as f:
+                raw = f.read((count or 16) *
+                             numpy.dtype(dtype or numpy.uint8).itemsize)
+            seed = numpy.frombuffer(raw, dtype=dtype or numpy.uint8)
+        if isinstance(seed, numpy.ndarray):
+            seed = int.from_bytes(
+                hashlib.sha256(seed.tobytes()).digest()[:8], "little")
+        elif isinstance(seed, (bytes, bytearray)):
+            seed = int.from_bytes(
+                hashlib.sha256(bytes(seed)).digest()[:8], "little")
+        self.seed_value = int(seed) & 0xFFFFFFFF
+        self.state = numpy.random.RandomState(self.seed_value)
+        self._jax_counter = 0
+        return self
+
+    # -- host-side sampling ------------------------------------------------
+
+    def randint(self, low, high=None, size=None):
+        with self._lock:
+            return self.state.randint(low, high, size)
+
+    def rand(self, *shape):
+        with self._lock:
+            return self.state.rand(*shape)
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        with self._lock:
+            return self.state.normal(loc, scale, size)
+
+    def uniform(self, low=0.0, high=1.0, size=None):
+        with self._lock:
+            return self.state.uniform(low, high, size)
+
+    def shuffle(self, arr):
+        with self._lock:
+            self.state.shuffle(arr)
+
+    def permutation(self, n):
+        with self._lock:
+            return self.state.permutation(n)
+
+    def fill(self, arr, vmin=-1.0, vmax=1.0):
+        """In-place uniform fill (the reference's weight-filler contract)."""
+        with self._lock:
+            arr[...] = self.state.uniform(vmin, vmax, arr.shape).astype(
+                arr.dtype)
+
+    def fill_normal(self, arr, mean=0.0, stddev=1.0):
+        with self._lock:
+            arr[...] = self.state.normal(mean, stddev, arr.shape).astype(
+                arr.dtype)
+
+    # -- device-side keys ---------------------------------------------------
+
+    def jax_key(self):
+        """Next JAX PRNG key in this generator's deterministic chain."""
+        import jax
+        with self._lock:
+            counter = self._jax_counter
+            self._jax_counter += 1
+        base = (self.seed_value if self.seed_value is not None
+                else 0xC0FFEE) & 0xFFFFFFFF
+        return jax.random.fold_in(jax.random.PRNGKey(base), counter)
+
+    # -- state management ---------------------------------------------------
+
+    def save_state(self):
+        return (self.state.get_state(), self._jax_counter, self.seed_value)
+
+    def restore_state(self, saved):
+        state, counter, seed_value = saved
+        self.state.set_state(state)
+        self._jax_counter = counter
+        self.seed_value = seed_value
+
+    def __getstate__(self):
+        return {"key": self.key, "seed_value": self.seed_value,
+                "numpy_state": self.state.get_state(),
+                "jax_counter": self._jax_counter}
+
+    def __setstate__(self, state):
+        self.key = state["key"]
+        self._lock = threading.Lock()
+        self.seed_value = state["seed_value"]
+        self.state = numpy.random.RandomState()
+        self.state.set_state(state["numpy_state"])
+        self._jax_counter = state["jax_counter"]
+
+
+_generators = {}
+_registry_lock = threading.Lock()
+
+
+def get(key="default"):
+    """The named-generator registry (``prng/random_generator.py:289``)."""
+    with _registry_lock:
+        gen = _generators.get(key)
+        if gen is None:
+            # stable across processes (hash() is randomized per process)
+            gen = RandomGenerator(key).seed(str(key).encode())
+            _generators[key] = gen
+        return gen

@@ -1,0 +1,63 @@
+"""Registry of all Unit subclasses.
+
+Re-designs ``veles/unit_registry.py:51-179``: a metaclass records every
+Unit subclass so the CLI frontend, forge packaging and workflow
+introspection can enumerate the available unit types; it also folds in
+the command-line argument registry so any unit can contribute flags.
+Each class gets a stable ``__id__`` UUID used by the export package
+format (consumed by the native runner, cf. ``libVeles/src/unit_factory.cc``).
+"""
+
+import uuid
+
+from veles_tpu.cmdline import CommandLineArgumentsRegistry
+
+#: Namespace for deterministic unit UUIDs (so the same class name always
+#: exports the same id — the native runner keys its factory on these).
+_UNIT_NAMESPACE = uuid.UUID("6ba7b812-9dad-11d1-80b4-00c04fd430c8")
+
+
+class UnitRegistry(CommandLineArgumentsRegistry):
+    """Metaclass: every concrete Unit subclass lands in ``units``."""
+
+    units = {}
+
+    def __init__(cls, name, bases, namespace):
+        super(UnitRegistry, cls).__init__(name, bases, namespace)
+        if namespace.get("hide_from_registry", False):
+            return
+        if "__id__" not in namespace:
+            cls.__id__ = str(uuid.uuid5(_UNIT_NAMESPACE, name))
+        UnitRegistry.units[name] = cls
+
+    @staticmethod
+    def find(name):
+        return UnitRegistry.units.get(name)
+
+    @staticmethod
+    def find_by_id(uid):
+        for cls in UnitRegistry.units.values():
+            if getattr(cls, "__id__", None) == uid:
+                return cls
+        return None
+
+
+class MappedUnitRegistry(UnitRegistry):
+    """Registry variant with an extra user-facing mapping key.
+
+    Subclass hierarchies that need name→class lookup by a custom key
+    (loaders, normalizers) set ``MAPPING`` on their classes; cf.
+    ``veles/unit_registry.py:178``.
+    """
+
+    mapping = "base"
+    base = object
+
+    def __init__(cls, name, bases, namespace):
+        super(MappedUnitRegistry, cls).__init__(name, bases, namespace)
+        mapping = namespace.get("MAPPING")
+        if mapping:
+            registry = type(cls).mapped
+            registry[mapping] = cls
+
+    mapped = {}
