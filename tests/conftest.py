@@ -2,19 +2,27 @@
 
 Mirrors the reference's multi-backend test strategy (SURVEY.md §4): the
 numerics tests run identically on CPU and TPU; sharding tests get 8
-virtual devices via XLA's host-platform device-count flag. Must run
-before the first ``import jax`` anywhere in the test process.
+virtual devices via XLA's host-platform device-count flag.
+
+NOTE: this environment registers a TPU ("axon") PJRT plugin from
+sitecustomize and pins ``JAX_PLATFORMS=axon``, so the env var alone is
+not enough — we must also flip ``jax_platforms`` after import, before
+any computation runs.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("VELES_TPU_BACKEND", "cpu")
 
-import sys
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()

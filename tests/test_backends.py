@@ -1,0 +1,110 @@
+"""Device dispatch + Array coherence protocol tests."""
+
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.backends import (BackendRegistry, CPUDevice, Device,
+                                NumpyDevice, resolve_backend)
+from veles_tpu.memory import Array, roundup, watcher
+
+
+def test_registry_contents():
+    assert set(BackendRegistry.backends) >= {"tpu", "cpu", "numpy"}
+
+
+def test_dispatch_by_name():
+    assert isinstance(Device(backend="numpy"), NumpyDevice)
+    assert isinstance(Device(backend="cpu"), CPUDevice)
+
+
+def test_auto_resolution_prefers_available():
+    # under tests JAX is CPU-only, so auto → cpu
+    assert resolve_backend("auto") in ("cpu", "tpu")
+
+
+def test_unknown_backend_raises():
+    with pytest.raises((ValueError, RuntimeError, KeyError)):
+        Device(backend="nonexistent")
+
+
+def test_numpy_device_does_not_exist():
+    assert not NumpyDevice().exists
+
+
+def test_device_pickle_identity():
+    dev = Device(backend="cpu")
+    dev2 = pickle.loads(pickle.dumps(dev))
+    assert dev2.BACKEND == "cpu"
+
+
+class TestArray(object):
+    def test_host_only(self):
+        a = Array(numpy.arange(6, dtype=numpy.float32).reshape(2, 3))
+        assert a.shape == (2, 3)
+        assert a.devmem is a.mem  # no device attached
+
+    def test_upload_download_roundtrip(self):
+        dev = Device(backend="cpu")
+        a = Array(numpy.arange(6, dtype=numpy.float32).reshape(2, 3))
+        a.initialize(dev)
+        dm = a.devmem
+        assert dm.shape == (2, 3)
+        # simulate a device-side update (a jitted step output)
+        a.assign_devmem(dm * 2)
+        host = a.map_read()
+        numpy.testing.assert_allclose(host, numpy.arange(6).reshape(2, 3) * 2)
+
+    def test_map_write_marks_dirty(self):
+        dev = Device(backend="cpu")
+        a = Array(numpy.zeros((2, 2), numpy.float32))
+        a.initialize(dev)
+        _ = a.devmem
+        a.map_write()[0, 0] = 5.0
+        a.unmap()
+        assert float(numpy.asarray(a.devmem)[0, 0]) == 5.0
+
+    def test_map_invalidate_skips_download(self):
+        dev = Device(backend="cpu")
+        a = Array(numpy.zeros((2, 2), numpy.float32))
+        a.initialize(dev)
+        a.assign_devmem(a.devmem + 7)  # device dirty
+        buf = a.map_invalidate()       # host will overwrite: no download
+        buf[...] = 1.0
+        numpy.testing.assert_allclose(a.map_read(), numpy.ones((2, 2)))
+
+    def test_numpy_device_stays_host(self):
+        a = Array(numpy.ones(3))
+        a.initialize(NumpyDevice())
+        assert a.device is None
+        assert a.devmem is a.mem
+
+    def test_pickle_syncs_device_state(self):
+        dev = Device(backend="cpu")
+        a = Array(numpy.zeros(4, numpy.float32))
+        a.initialize(dev)
+        a.assign_devmem(a.devmem + 3)
+        a2 = pickle.loads(pickle.dumps(a))
+        numpy.testing.assert_allclose(a2.mem, 3 * numpy.ones(4))
+        assert a2.device is None
+
+    def test_getitem_setitem(self):
+        a = Array(numpy.zeros((2, 2)))
+        a[0, 1] = 9
+        assert a[0, 1] == 9
+
+    def test_watcher_accounting(self):
+        dev = Device(backend="cpu")
+        before = watcher.total
+        a = Array(numpy.zeros((100, 100), numpy.float32))
+        a.initialize(dev)
+        _ = a.devmem
+        assert watcher.total == before + 40000
+        a.reset()
+        assert watcher.total == before
+
+
+def test_roundup():
+    assert roundup(5, 8) == 8
+    assert roundup(16, 8) == 16

@@ -1,0 +1,234 @@
+"""Device abstraction: pluggable compute backends.
+
+Re-designs ``veles/backends.py`` for the XLA world. The reference
+dispatched between OpenCL/CUDA/numpy devices and rebound per-unit
+``ocl_run``/``cuda_run``/``numpy_run`` methods; here the backends are
+
+* ``tpu``   — JAX on TPU chips (the production path),
+* ``cpu``   — JAX on host CPU (same code, same numerics tests),
+* ``numpy`` — pure-numpy pseudo-device (no JAX at all; debugging and
+  the loss-parity oracle),
+* ``auto``  — first available of tpu > cpu > numpy
+  (``veles/backends.py:405-422``).
+
+``Device(backend=...)`` dispatches on the backend name through
+:class:`BackendRegistry` like the reference (``backends.py:190-197``).
+The OpenCL autotune database (BLOCK_SIZE/VECTOR_OPT per device,
+``backends.py:672-731``) has no TPU analogue by design: XLA's
+compilation cache plays that role; what survives is the *rating* notion
+(``computing_power``) used for load balancing.
+"""
+
+import os
+import threading
+
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+from veles_tpu.cmdline import CommandLineArgumentsRegistry
+
+
+class BackendRegistry(CommandLineArgumentsRegistry):
+    """Metaclass mapping backend names to Device classes."""
+
+    backends = {}
+
+    def __init__(cls, name, bases, namespace):
+        super(BackendRegistry, cls).__init__(name, bases, namespace)
+        backend = namespace.get("BACKEND")
+        if backend:
+            BackendRegistry.backends[backend] = cls
+
+
+def resolve_backend(name=None):
+    """Resolve a backend name, expanding ``auto`` by priority."""
+    name = (name or os.environ.get("VELES_TPU_BACKEND") or
+            root.common.engine.get("backend", "auto"))
+    if name == "auto":
+        for candidate in ("tpu", "cpu", "numpy"):
+            if BackendRegistry.backends[candidate].available():
+                return candidate
+        raise RuntimeError("no backend available")
+    return name
+
+
+class Device(Logger, metaclass=BackendRegistry):
+    """Base device; ``Device(backend="tpu")`` dispatches to a subclass."""
+
+    BACKEND = None
+
+    def __new__(cls, *args, **kwargs):
+        if cls is not Device:
+            return object.__new__(cls)
+        backend = resolve_backend(kwargs.get("backend"))
+        target = BackendRegistry.backends.get(backend)
+        if target is None or target is Device:
+            raise ValueError(
+                "unknown backend %r; registered: %s" %
+                (backend, sorted(BackendRegistry.backends)))
+        return object.__new__(target)
+
+    def __init__(self, **kwargs):
+        kwargs.pop("backend", None)
+        device_index = kwargs.pop("device_index", 0)
+        super(Device, self).__init__(**kwargs)
+        self.device_index = device_index
+
+    # -- capabilities ------------------------------------------------------
+
+    @property
+    def backend_name(self):
+        return self.BACKEND
+
+    @property
+    def exists(self):
+        """True for real accelerators (numpy pseudo-device → False)."""
+        return True
+
+    @property
+    def is_jax(self):
+        return False
+
+    def sync(self):
+        """Block until all queued device work has completed."""
+
+    def compute_dtype(self, dtype=None):
+        import numpy
+        return numpy.dtype(dtype or root.common.engine.get(
+            "precision_type", "float32"))
+
+    def thread_pool_attach(self):
+        """Per-thread context hook (the CUDA push/pop analogue); no-op."""
+
+    def thread_pool_detach(self):
+        pass
+
+    @classmethod
+    def available(cls):
+        return False
+
+    # Devices appear in pickled workflows: store only identity.
+    def __getstate__(self):
+        return {"BACKEND": self.BACKEND, "device_index": self.device_index}
+
+    def __setstate__(self, state):
+        self.__init__(device_index=state.get("device_index", 0))
+
+    @staticmethod
+    def init_parser(parser):
+        parser.add_argument(
+            "-a", "--backend", default="auto",
+            choices=sorted(BackendRegistry.backends) + ["auto"],
+            help="computation backend")
+        parser.add_argument(
+            "-d", "--device", default="0",
+            help="device index (for multi-chip hosts)")
+        return parser
+
+    def __repr__(self):
+        return "<%s backend=%s>" % (type(self).__name__, self.BACKEND)
+
+
+class JaxDevice(Device):
+    """Common behavior for JAX-backed devices (TPU and CPU)."""
+
+    PLATFORM = None
+
+    def __init__(self, **kwargs):
+        super(JaxDevice, self).__init__(**kwargs)
+        import jax
+        self._jax_ = jax
+        devices = [d for d in jax.devices()
+                   if self.PLATFORM in (None, d.platform)]
+        if not devices:
+            raise RuntimeError("no %s devices visible to JAX" % self.PLATFORM)
+        self.jax_devices = devices
+        self.jax_device = devices[min(self.device_index, len(devices) - 1)]
+        self.debug("using %s (%d %s device(s) visible)",
+                   self.jax_device, len(devices), self.PLATFORM or "jax")
+
+    @property
+    def is_jax(self):
+        return True
+
+    def put(self, array):
+        """Host → device memory (HBM on TPU)."""
+        return self._jax_.device_put(array, self.jax_device)
+
+    def get(self, array):
+        """Device → host numpy."""
+        import numpy
+        return numpy.asarray(array)
+
+    def sync(self):
+        # effects_barrier waits for all dispatched computations; the
+        # device_put fallback only orders transfers, kept as last resort
+        barrier = getattr(self._jax_, "effects_barrier", None)
+        if barrier is not None:
+            barrier()
+        else:  # pragma: no cover
+            self._jax_.block_until_ready(
+                self._jax_.device_put(0, self.jax_device))
+
+    @property
+    def memory_stats(self):
+        try:
+            return self.jax_device.memory_stats() or {}
+        except Exception:
+            return {}
+
+
+class TPUDevice(JaxDevice):
+    """JAX on TPU. One chip by default; meshes live in veles_tpu.parallel."""
+
+    BACKEND = "tpu"
+    PLATFORM = "tpu"
+
+    @classmethod
+    def available(cls):
+        try:
+            import jax
+            return any(d.platform == "tpu" for d in jax.devices())
+        except Exception:
+            return False
+
+
+class CPUDevice(JaxDevice):
+    """JAX on host CPU: identical program, interpretable numerics."""
+
+    BACKEND = "cpu"
+    PLATFORM = "cpu"
+
+    @classmethod
+    def available(cls):
+        try:
+            import jax
+            return any(d.platform == "cpu" for d in jax.devices())
+        except Exception:
+            return False
+
+
+class NumpyDevice(Device):
+    """Pure-numpy pseudo-device (``veles/backends.py:918-948``)."""
+
+    BACKEND = "numpy"
+
+    @property
+    def exists(self):
+        return False
+
+    @classmethod
+    def available(cls):
+        return True
+
+
+_default_device = None
+_default_lock = threading.Lock()
+
+
+def default_device():
+    """Process-wide lazily created device honoring config/env selection."""
+    global _default_device
+    with _default_lock:
+        if _default_device is None:
+            _default_device = Device(backend=None)
+        return _default_device

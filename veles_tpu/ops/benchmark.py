@@ -1,0 +1,43 @@
+"""Device benchmark (``ocl/benchmark.cl`` + ``veles/accelerated_units.py:
+706-824``): repeated square GEMM timing. Produces the ``computing_power``
+rating (1000/dt of a 1500² gemm in the reference) that masters use for
+slave load balancing; also reports achieved TFLOP/s for bench.py.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def gemm_benchmark(size=1500, repeats=5, dtype=jnp.bfloat16, device=None):
+    """Time ``repeats`` chained size×size matmuls; returns a dict."""
+    key = jax.random.PRNGKey(0)
+    kwargs = {}
+    if device is not None and getattr(device, "is_jax", False):
+        kwargs["device"] = device.jax_device
+    a = jax.device_put(jax.random.normal(key, (size, size), jnp.float32)
+                       .astype(dtype), **kwargs)
+    b = jax.device_put(jax.random.normal(key, (size, size), jnp.float32)
+                       .astype(dtype), **kwargs)
+
+    @jax.jit
+    def chain(a, b):
+        def body(i, x):
+            return jnp.dot(x, b, preferred_element_type=jnp.float32).astype(
+                a.dtype)
+        return jax.lax.fori_loop(0, repeats, body, a)
+
+    chain(a, b).block_until_ready()  # compile
+    start = time.perf_counter()
+    chain(a, b).block_until_ready()
+    dt = time.perf_counter() - start
+    flops = 2.0 * size ** 3 * repeats
+    return {
+        "seconds": dt,
+        "computing_power": 1000.0 * repeats / dt,
+        "tflops": flops / dt / 1e12,
+        "size": size,
+        "dtype": str(dtype.__name__ if hasattr(dtype, "__name__")
+                     else dtype),
+    }

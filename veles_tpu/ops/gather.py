@@ -1,0 +1,40 @@
+"""On-device minibatch gather (``ocl/fullbatch_loader.cl``,
+``cuda/fullbatch_loader.cu:10-37``).
+
+The reference keeps the whole dataset in device memory and gathers each
+minibatch by index with a kernel. Here the dataset is an HBM-resident
+``jax.Array`` and the gather is a jitted ``take`` — XLA emits a fused
+dynamic-gather; under the step compiler it fuses straight into the
+forward matmul's input so the minibatch never materializes in HBM.
+
+Padding contract: indices < 0 mark padded slots (short tail batches);
+their rows are zero-filled and their labels set to ``pad_label``, which
+matches the reference's minibatch_offset tail handling
+(``veles/loader/base.py:880``).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("pad_label",))
+def gather_minibatch(data, indices, labels=None, pad_label=-1):
+    """Gather rows of ``data`` (and ``labels``) by ``indices``.
+
+    Returns (minibatch_data, minibatch_labels|None). Negative indices
+    produce zero rows / ``pad_label`` labels.
+    """
+    valid = indices >= 0
+    safe = jnp.where(valid, indices, 0)
+    mb = jnp.take(data, safe, axis=0)
+    mask_shape = (indices.shape[0],) + (1,) * (data.ndim - 1)
+    mb = mb * valid.reshape(mask_shape).astype(mb.dtype)
+    if labels is None:
+        return mb, None
+    lbl = jnp.take(labels, safe, axis=0)
+    lbl = jnp.where(
+        valid.reshape((indices.shape[0],) + (1,) * (labels.ndim - 1)),
+        lbl, pad_label)
+    return mb, lbl

@@ -1,0 +1,18 @@
+"""Input joining (``ocl/join.jcl``, ``cuda/join.jcu``): concatenate
+several arrays along the feature axis, flattening trailing dims. The
+reference jinja-templates a copy kernel per input list; XLA's concatenate
+does the same packing without a bespoke kernel.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def join_arrays(*arrays):
+    """Concat along axis 1, flattening each input to (batch, -1)."""
+    if not arrays:
+        raise ValueError("nothing to join")
+    batch = arrays[0].shape[0]
+    flat = [a.reshape(batch, -1) for a in arrays]
+    return jnp.concatenate(flat, axis=1)

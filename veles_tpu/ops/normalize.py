@@ -1,0 +1,28 @@
+"""Mean/dispersion normalization (``ocl/mean_disp_normalizer.cl``,
+``cuda/mean_disp_normalizer.cu``): out = (x - mean) * rdisp, broadcast
+over the sample axis. One fused VPU pass; XLA fuses it into neighbors.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def mean_disp_normalize(x, mean, rdisp):
+    """(x - mean) * rdisp with mean/rdisp broadcast over axis 0."""
+    x32 = x.astype(jnp.float32)
+    return (x32 - mean.astype(jnp.float32)) * rdisp.astype(jnp.float32)
+
+
+@jax.jit
+def compute_mean_disp(data):
+    """Host-free analysis pass: per-feature mean and reciprocal spread.
+
+    The reference computes mean and dispersion = (max - min) per feature
+    during loader analysis; rdisp = 1/dispersion (guarded).
+    """
+    data32 = data.astype(jnp.float32)
+    mean = jnp.mean(data32, axis=0)
+    spread = jnp.max(data32, axis=0) - jnp.min(data32, axis=0)
+    rdisp = jnp.where(spread > 0, 1.0 / jnp.maximum(spread, 1e-12), 1.0)
+    return mean, rdisp

@@ -1,0 +1,87 @@
+"""Device random number generation.
+
+The reference fills buffers with xorshift1024* on the GPU
+(``ocl/random.cl:1-125``, ``cuda/random.cu:46-73``) seeded from the host
+RandomGenerator. Here:
+
+* :func:`xorshift128plus` — exact host implementation of the xorshift128+
+  step the reference exposes (``veles/prng/random_generator.py:273``),
+  used for state-evolution parity tests;
+* :func:`uniform` — counter-based ``jax.random`` fill (the idiomatic TPU
+  path: stateless, splittable, reproducible across meshes);
+* :func:`pallas_uniform` — hardware PRNG fill inside a Pallas kernel
+  (``pltpu.prng_random_bits``), for fusing randomness into larger
+  kernels (dropout masks) without a second HBM pass.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+_U64 = (1 << 64) - 1
+
+
+def xorshift128plus(state):
+    """One xorshift128+ step on a 2-element uint64 state (host-side).
+
+    Returns (new_state, output). Bit-exact with the reference's
+    generator so stream parity can be asserted in tests.
+    """
+    s0, s1 = int(state[0]), int(state[1])
+    x = s0
+    y = s1
+    x ^= (x << 23) & _U64
+    x ^= x >> 17
+    x ^= y ^ (y >> 26)
+    new = numpy.array([y, x], dtype=numpy.uint64)
+    return new, (x + y) & _U64
+
+
+def fill_xorshift(state, count):
+    """Fill ``count`` uint64s, evolving the 2-word state (host loop)."""
+    out = numpy.empty(count, dtype=numpy.uint64)
+    for i in range(count):
+        state, value = xorshift128plus(state)
+        out[i] = value
+    return state, out
+
+
+@functools.partial(jax.jit, static_argnames=("shape", "dtype"))
+def uniform(key, shape, vmin=0.0, vmax=1.0, dtype=jnp.float32):
+    """Uniform fill via JAX's counter-based PRNG."""
+    return jax.random.uniform(key, shape, dtype=dtype, minval=vmin,
+                              maxval=vmax)
+
+
+@functools.partial(jax.jit, static_argnames=("shape", "dtype"))
+def normal(key, shape, mean=0.0, stddev=1.0, dtype=jnp.float32):
+    return mean + stddev * jax.random.normal(key, shape, dtype=dtype)
+
+
+def pallas_uniform(seed, shape, vmin=0.0, vmax=1.0):
+    """Uniform fill with the TPU hardware PRNG inside a Pallas kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if len(shape) != 2:
+        raise ValueError("pallas_uniform wants a 2-D shape")
+
+    def kernel(seed_ref, o_ref):
+        pltpu.prng_seed(seed_ref[0])
+        bits = pltpu.bitcast(pltpu.prng_random_bits(o_ref.shape),
+                             jnp.uint32)
+        # map uint32 bits to [vmin, vmax): keep 24 mantissa-safe bits.
+        # Mosaic can't cast uint32->f32; after >>8 the top byte is zero,
+        # so a bitcast to int32 is value-preserving and casts cleanly.
+        u24 = pltpu.bitcast(bits >> 8, jnp.int32)
+        u01 = u24.astype(jnp.float32) * (1.0 / (1 << 24))
+        o_ref[...] = vmin + (vmax - vmin) * u01
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
+    )(jnp.asarray([seed], dtype=jnp.int32))

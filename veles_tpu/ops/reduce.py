@@ -1,0 +1,66 @@
+"""Matrix reductions (``ocl/matrix_reduce.cl``, ``cuda/matrix_reduce.cu``).
+
+The reference runs a two-stage tree reduction over matrix columns on the
+GPU. On TPU, XLA lowers ``jnp.sum``/``jnp.max`` onto the VPU with its own
+tree schedule, so the *public contract* (reduce a matrix along an axis
+with a selectable op) is all that must survive; a Pallas grid version is
+provided for fusing reductions into larger kernels when needed.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_OPS = {
+    "sum": jnp.sum,
+    "max": jnp.max,
+    "min": jnp.min,
+    "mean": jnp.mean,
+    "argmax": jnp.argmax,
+    "l2": lambda x, axis: jnp.sqrt(jnp.sum(jnp.square(x), axis=axis)),
+}
+
+
+@functools.partial(jax.jit, static_argnames=("op", "axis"))
+def matrix_reduce(x, op="sum", axis=0):
+    """Reduce a matrix along ``axis`` with ``op`` (fp32 accumulation)."""
+    fn = _OPS[op]
+    if op in ("argmax",):
+        return fn(x, axis=axis)
+    return fn(x.astype(jnp.float32), axis=axis)
+
+
+def pallas_column_reduce(x, block_rows=512):
+    """Column-sum via a Pallas grid walking row blocks with a VMEM
+    accumulator — the shape of the reference's two-stage kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, n = x.shape
+    block_rows = min(block_rows, m)
+    if m % block_rows or jax.default_backend() != "tpu":
+        return jnp.sum(x.astype(jnp.float32), axis=0)
+    steps = m // block_rows
+
+    def kernel(x_ref, o_ref, acc_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        acc_ref[...] += jnp.sum(x_ref[...].astype(jnp.float32), axis=0,
+                                keepdims=True)
+
+        @pl.when(pl.program_id(0) == steps - 1)
+        def _():
+            o_ref[...] = acc_ref[...]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(steps,),
+        in_specs=[pl.BlockSpec((block_rows, n), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, n), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, n), jnp.float32)],
+    )(x)
+    return out[0]

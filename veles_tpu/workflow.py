@@ -293,7 +293,11 @@ class Workflow(Container):
 
     def package_export(self, path, precision="float32"):
         """Export an inference package (see :mod:`veles_tpu.export`)."""
-        from veles_tpu.export.package import export_workflow
+        try:
+            from veles_tpu.export.package import export_workflow
+        except ImportError as exc:
+            raise NotImplementedError(
+                "the export subsystem is not available: %s" % exc)
         return export_workflow(self, path, precision=precision)
 
     @property
