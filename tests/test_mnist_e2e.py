@@ -1,0 +1,86 @@
+"""End-to-end training slice: the MNIST FC workflow on synthetic digits
+(BASELINE config 1 topology) — loss parity CPU(jax) vs numpy oracle.
+"""
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import Device, NumpyDevice
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.models.mnist import MnistWorkflow
+
+
+class synthetic_digits(object):
+    """Linearly separable-ish class blobs rendered as images.
+
+    A picklable provider object (loaders ride inside snapshots)."""
+
+    def __init__(self, n_train=600, n_valid=120, side=12, n_classes=10,
+                 seed=3):
+        self.args = (n_train, n_valid, side, n_classes, seed)
+
+    def __call__(self):
+        n_train, n_valid, side, n_classes, seed = self.args
+        rng = numpy.random.RandomState(seed)
+        prototypes = rng.rand(n_classes, side * side) * 2 - 1
+
+        def make(n):
+            labels = rng.randint(0, n_classes, n).astype(numpy.int32)
+            data = (prototypes[labels] + rng.normal(
+                0, 0.35, (n, side * side))).astype(numpy.float32)
+            return data.reshape(n, side, side), labels
+
+        train_x, train_y = make(n_train)
+        valid_x, valid_y = make(n_valid)
+        return train_x, train_y, valid_x, valid_y
+
+
+def build(device, max_epochs=4, seed=42):
+    prng.get().seed(seed)
+    prng.get("loader").seed(seed + 1)
+    wf = MnistWorkflow(DummyLauncher(), provider=synthetic_digits(),
+                       layers=(32,), minibatch_size=60,
+                       learning_rate=0.08, max_epochs=max_epochs)
+    wf.initialize(device=device)
+    return wf
+
+
+def test_trains_and_improves():
+    wf = build(Device(backend="cpu"))
+    wf.run()
+    assert bool(wf.stopped)
+    history = wf.decision.epoch_history
+    assert len(history) == 4
+    first = history[0]["validation"]["normalized"]
+    last = history[-1]["validation"]["normalized"]
+    assert last < first, (first, last)
+    assert last < 0.25, "validation error %.3f too high" % last
+    results = wf.gather_results()
+    assert "best_n_err_pt" in results
+
+
+def test_loss_parity_jax_vs_numpy_oracle():
+    """Same seeds => numerically close training curves on both backends
+    (the reference's CUDA-vs-numpy parity discipline, BASELINE.md)."""
+    wf_jax = build(Device(backend="cpu"), max_epochs=2)
+    wf_jax.run()
+    wf_np = build(NumpyDevice(), max_epochs=2)
+    wf_np.run()
+    h1 = [e["train"]["normalized"] for e in wf_jax.decision.epoch_history]
+    h2 = [e["train"]["normalized"] for e in wf_np.decision.epoch_history]
+    numpy.testing.assert_allclose(h1, h2, atol=0.02)
+
+
+def test_snapshot_resume_mid_training():
+    import pickle
+    wf = build(Device(backend="cpu"), max_epochs=2)
+    wf.run()
+    blob = pickle.dumps(wf)
+    wf2 = pickle.loads(blob)
+    wf2.workflow = DummyLauncher()
+    wf2.decision.max_epochs = 4
+    wf2.decision.complete <<= False
+    wf2.initialize(device=Device(backend="cpu"))
+    wf2.run()
+    assert len(wf2.decision.epoch_history) >= 2

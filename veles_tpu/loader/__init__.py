@@ -1,0 +1,12 @@
+"""Data layer: minibatch-serving loader hierarchy (SURVEY.md §2.3).
+
+``Loader`` is the base minibatch server (classes, shuffling, epochs,
+fault-tolerant requeue); ``FullBatchLoader`` keeps the whole dataset
+device-resident and gathers minibatches on-chip; image/hdf5/pickles/
+interactive/restful variants layer on top.
+"""
+
+from veles_tpu.loader.base import (CLASS_NAMES, TEST, TRAIN, VALIDATION,  # noqa
+                                   Loader, UserLoaderRegistry)
+from veles_tpu.loader.fullbatch import (FullBatchLoader,  # noqa: F401
+                                        FullBatchLoaderMSE)

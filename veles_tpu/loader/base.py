@@ -1,0 +1,275 @@
+"""Loader: the minibatch server.
+
+Re-designs ``veles/loader/base.py`` (Loader :120, serve_next_minibatch
+:726, _advance_global_offset :880, distribution hooks :631-687).
+
+Semantics kept from the reference:
+
+* three sample classes laid out consecutively in index space —
+  TEST [0, t), VALIDATION [t, t+v), TRAIN [t+v, total);
+* one epoch = one sequential pass over the whole index space (test
+  first, then validation, then train), minibatch by minibatch;
+* ``shuffled_indices`` is the global permutation; only the TRAIN
+  segment reshuffles between epochs, from the loader's own seeded PRNG
+  (validation/test order is stable);
+* ``last_minibatch``/``epoch_ended`` are shared Bools the Decision unit
+  gates on; the final minibatch of a segment may be short — it is
+  padded to ``max_minibatch_size`` with index −1 (on-device gather
+  zero-fills those rows) so every step has a static shape for XLA;
+* distribution: the master serves *indices only*
+  (``generate_data_for_slave``), slaves gather locally
+  (``apply_data_from_master``); a dropped slave's pending minibatches
+  go to ``failed_minibatches`` and are re-served
+  (``drop_slave``, ``loader/base.py:679-687``);
+* ``--train-ratio`` subsampling for ensemble training.
+"""
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.memory import Array
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+from veles_tpu.unit_registry import UnitRegistry
+
+TEST = 0
+VALIDATION = 1
+TRAIN = 2
+CLASS_NAMES = ("test", "validation", "train")
+
+
+class UserLoaderRegistry(UnitRegistry):
+    """Maps MAPPING names to loader classes (``loader/base.py:83``)."""
+
+    loaders = {}
+
+    def __init__(cls, name, bases, namespace):
+        super(UserLoaderRegistry, cls).__init__(name, bases, namespace)
+        mapping = namespace.get("MAPPING")
+        if mapping:
+            UserLoaderRegistry.loaders[mapping] = cls
+
+
+class Loader(Unit, metaclass=UserLoaderRegistry):
+    """Base minibatch server; subclasses implement load_data() and
+    fill_minibatch()."""
+
+    hide_from_registry = True
+    view_group = "LOADER"
+
+    def __init__(self, workflow, **kwargs):
+        self.max_minibatch_size = kwargs.pop("minibatch_size", 100)
+        self.train_ratio = kwargs.pop("train_ratio", 1.0)
+        self.shuffle_limit = kwargs.pop("shuffle_limit", numpy.inf)
+        self.rand_name = kwargs.pop("rand", "loader")
+        super(Loader, self).__init__(workflow, **kwargs)
+        self.class_lengths = [0, 0, 0]
+        self.shuffled_indices = Array()
+        self.minibatch_data = Array()
+        self.minibatch_labels = Array()
+        self.minibatch_indices = Array()
+        self.minibatch_size = 0
+        self.minibatch_class = TRAIN
+        self.minibatch_offset = 0
+        self.epoch_number = 0
+        self.epoch_ended = Bool(False)
+        self.last_minibatch = Bool(False)
+        self.train_ended = Bool(False)
+        self.failed_minibatches = []
+        self._pending_ = {}
+        self.samples_served = 0
+        self._global_offset = 0
+        self.has_labels = True
+
+    def init_unpickled(self):
+        super(Loader, self).init_unpickled()
+        self._pending_ = {}
+
+    # -- geometry ----------------------------------------------------------
+
+    @property
+    def total_samples(self):
+        return int(sum(self.class_lengths))
+
+    @property
+    def class_end_offsets(self):
+        ends, acc = [], 0
+        for length in self.class_lengths:
+            acc += length
+            ends.append(acc)
+        return ends
+
+    def class_of_offset(self, offset):
+        """Class index owning global offset (offset is the END of a mb)."""
+        for klass, end in enumerate(self.class_end_offsets):
+            if offset <= end and self.class_lengths[klass]:
+                if offset > end - self.class_lengths[klass]:
+                    return klass
+        raise ValueError("offset %d outside dataset" % offset)
+
+    # -- to override -------------------------------------------------------
+
+    def load_data(self):
+        """Set class_lengths (and stage actual data)."""
+        raise NotImplementedError
+
+    def create_minibatch_data(self):
+        """Allocate minibatch_data for max_minibatch_size samples."""
+        raise NotImplementedError
+
+    def fill_minibatch(self):
+        """Fill minibatch_data/labels from minibatch_indices."""
+        raise NotImplementedError
+
+    def on_before_fill(self):
+        pass
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def initialize(self, **kwargs):
+        self.load_data()
+        if self.total_samples == 0:
+            raise ValueError("%s loaded an empty dataset" % self.name)
+        if self.train_ratio < 1.0 and self.class_lengths[TRAIN]:
+            self.class_lengths[TRAIN] = max(1, int(
+                self.class_lengths[TRAIN] * self.train_ratio))
+        self.max_minibatch_size = min(self.max_minibatch_size, max(
+            length for length in self.class_lengths if length) if any(
+                self.class_lengths) else self.max_minibatch_size)
+        if self.shuffled_indices.mem is None:
+            self.shuffled_indices.reset(
+                numpy.arange(self.total_samples, dtype=numpy.int32))
+        self.minibatch_indices.reset(
+            numpy.zeros(self.max_minibatch_size, numpy.int32))
+        if self.has_labels:
+            self.minibatch_labels.reset(
+                numpy.zeros(self.max_minibatch_size, numpy.int32))
+        self.create_minibatch_data()
+        self._global_offset = 0
+        self.epoch_ended <<= False
+        self.last_minibatch <<= False
+
+    def run(self):
+        self.serve_next_minibatch()
+
+    # -- the serving loop --------------------------------------------------
+
+    def _advance_global_offset(self):
+        """Move to the next minibatch; handles epoch wrap + reshuffle."""
+        if self._global_offset >= self.total_samples:
+            self._finish_epoch()
+        ends = self.class_end_offsets
+        klass = None
+        for ci, end in enumerate(ends):
+            if self._global_offset < end and self.class_lengths[ci]:
+                klass = ci
+                break
+        count = min(self.max_minibatch_size,
+                    ends[klass] - self._global_offset)
+        start = self._global_offset
+        self._global_offset += count
+        self.minibatch_class = klass
+        self.minibatch_offset = self._global_offset
+        self.minibatch_size = count
+        self.last_minibatch <<= (self._global_offset == ends[klass])
+        self.train_ended <<= (klass == TRAIN and
+                              self._global_offset == ends[TRAIN])
+        self.epoch_ended <<= (self._global_offset == self.total_samples)
+        return start, count
+
+    def _finish_epoch(self):
+        self.epoch_number += 1
+        self._global_offset = 0
+        if self.epoch_number <= self.shuffle_limit:
+            self.shuffle()
+
+    def shuffle(self):
+        """Reshuffle the TRAIN segment only."""
+        if not self.class_lengths[TRAIN]:
+            return
+        indices = self.shuffled_indices.map_write()
+        train_start = self.class_end_offsets[VALIDATION]
+        segment = indices[train_start:self.total_samples]
+        prng.get(self.rand_name).shuffle(segment)
+        indices[train_start:self.total_samples] = segment
+
+    def serve_next_minibatch(self, slave_id=None):
+        if self.failed_minibatches:
+            start, count = self.failed_minibatches.pop()
+            self._restore_failed(start, count)
+        else:
+            start, count = self._advance_global_offset()
+        if slave_id is not None:
+            self._pending_.setdefault(slave_id, []).append((start, count))
+        indices = self.shuffled_indices.map_read()[start:start + count]
+        mb = self.minibatch_indices.map_invalidate()
+        mb[:count] = indices
+        mb[count:] = -1  # pad short tails: static shapes for XLA
+        self.on_before_fill()
+        self.fill_minibatch()
+        self.samples_served += count
+        self.event("minibatch", "single", klass=self.minibatch_class,
+                   size=count, epoch=self.epoch_number)
+
+    def _restore_failed(self, start, count):
+        ends = self.class_end_offsets
+        for klass, end in enumerate(ends):
+            if start < end:
+                self.minibatch_class = klass
+                break
+        self.minibatch_size = count
+        self.minibatch_offset = start + count
+        # a requeued minibatch is mid-segment by definition: epoch flags
+        # must not carry over from the previous serve (double accounting)
+        self.last_minibatch <<= False
+        self.epoch_ended <<= False
+        self.train_ended <<= False
+
+    # -- distribution (master serves indices only) -------------------------
+
+    def generate_data_for_slave(self, slave=None):
+        start, count = self._advance_global_offset()
+        sid = getattr(slave, "id", slave)
+        self._pending_.setdefault(sid, []).append((start, count))
+        indices = self.shuffled_indices.map_read()[start:start + count]
+        return {"indices": numpy.asarray(indices),
+                "class": self.minibatch_class,
+                "start": start, "size": count,
+                "epoch": self.epoch_number,
+                "last": bool(self.last_minibatch),
+                "epoch_ended": bool(self.epoch_ended)}
+
+    def apply_data_from_master(self, data):
+        count = data["size"]
+        self.minibatch_class = data["class"]
+        self.minibatch_size = count
+        self.epoch_number = data["epoch"]
+        self.last_minibatch <<= data["last"]
+        self.epoch_ended <<= data["epoch_ended"]
+        mb = self.minibatch_indices.map_invalidate()
+        mb[:count] = data["indices"]
+        mb[count:] = -1
+        self.on_before_fill()
+        self.fill_minibatch()
+
+    def generate_data_for_master(self):
+        return {"served": self.samples_served}
+
+    def apply_data_from_slave(self, data, slave=None):
+        sid = getattr(slave, "id", slave)
+        pending = self._pending_.get(sid)
+        if pending:
+            pending.pop(0)
+
+    def drop_slave(self, slave=None):
+        """Requeue everything a dead slave held (fault tolerance)."""
+        sid = getattr(slave, "id", slave)
+        for job in self._pending_.pop(sid, []):
+            self.failed_minibatches.append(job)
+
+    @staticmethod
+    def init_parser(parser):
+        parser.add_argument(
+            "--train-ratio", type=float, default=1.0,
+            help="fraction of the train set to use (ensembles)")
+        return parser

@@ -1,0 +1,123 @@
+"""Device-resident full-batch loaders.
+
+Re-designs ``veles/loader/fullbatch.py:79-566``: the entire dataset
+lives in device memory (HBM ``jax.Array``); each minibatch is gathered
+on-device by index (:func:`veles_tpu.ops.gather.gather_minibatch`), so
+the host never touches sample data in the hot loop — the TPU analogue of
+the reference's ``fill_minibatch_data_labels`` kernel.
+
+Subclasses (or users) provide ``original_data``/``original_labels``
+numpy arrays via :meth:`load_dataset`; ``FullBatchLoaderMSE`` adds
+``original_targets`` for regression/autoencoder workflows.
+"""
+
+import numpy
+
+from veles_tpu.loader.base import Loader
+from veles_tpu.memory import Array
+from veles_tpu.normalization import NormalizerRegistry
+from veles_tpu.ops.gather import gather_minibatch
+
+
+class FullBatchLoader(Loader):
+    """Whole dataset on device; on-device minibatch gather."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.normalization_type = kwargs.pop("normalization_type", "none")
+        self.normalization_parameters = kwargs.pop(
+            "normalization_parameters", {})
+        super(FullBatchLoader, self).__init__(workflow, **kwargs)
+        self.original_data = Array()
+        self.original_labels = Array()
+        self.normalizer = None
+
+    # -- to provide --------------------------------------------------------
+
+    def load_dataset(self):
+        """Fill original_data/original_labels + class_lengths."""
+        raise NotImplementedError
+
+    def load_data(self):
+        if self.original_data.mem is not None:
+            # restored from snapshot: data (already normalized) came
+            # along in the pickle — do not re-load or re-normalize
+            self.has_labels = self.original_labels.mem is not None
+            return
+        self.load_dataset()
+        if self.original_data.mem is None:
+            raise ValueError("%s.load_dataset left original_data empty" %
+                             self.name)
+        self.has_labels = self.original_labels.mem is not None
+        self._normalize_data()
+
+    def _normalize_data(self):
+        self.normalizer = NormalizerRegistry.make(
+            self.normalization_type, **self.normalization_parameters)
+        if self.normalizer.is_identity:
+            return
+        data = self.original_data.map_write().astype(numpy.float32)
+        train_start = self.class_end_offsets[1]  # after test+validation
+        self.normalizer.analyze(data[train_start:])
+        self.original_data.reset(self.normalizer.normalize(data))
+
+    def create_minibatch_data(self):
+        sample_shape = tuple(self.original_data.shape[1:])
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + sample_shape, numpy.float32))
+
+    def initialize(self, device=None, **kwargs):
+        super(FullBatchLoader, self).initialize(**kwargs)
+        self.device = device
+        for arr in (self.original_data, self.original_labels,
+                    self.minibatch_data, self.minibatch_labels,
+                    self.minibatch_indices):
+            if isinstance(arr, Array) and arr.mem is not None \
+                    and device is not None:
+                arr.initialize(device)
+
+    def fill_minibatch(self):
+        self.minibatch_indices.unmap()
+        data, labels = gather_minibatch(
+            self.original_data.devmem, self.minibatch_indices.devmem,
+            self.original_labels.devmem if self.has_labels else None)
+        self.minibatch_data.assign_devmem(data)
+        if labels is not None:
+            self.minibatch_labels.assign_devmem(labels)
+
+
+class FullBatchLoaderMSE(FullBatchLoader):
+    """Adds per-sample regression targets (``fullbatch.py:563``)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.targets_normalization_type = kwargs.pop(
+            "targets_normalization_type", "none")
+        super(FullBatchLoaderMSE, self).__init__(workflow, **kwargs)
+        self.original_targets = Array()
+        self.minibatch_targets = Array()
+
+    def load_data(self):
+        super(FullBatchLoaderMSE, self).load_data()
+        if self.original_targets.mem is None:
+            raise ValueError("MSE loader needs original_targets")
+
+    def create_minibatch_data(self):
+        super(FullBatchLoaderMSE, self).create_minibatch_data()
+        tshape = tuple(self.original_targets.shape[1:])
+        self.minibatch_targets.reset(numpy.zeros(
+            (self.max_minibatch_size,) + tshape, numpy.float32))
+
+    def initialize(self, device=None, **kwargs):
+        super(FullBatchLoaderMSE, self).initialize(device=device, **kwargs)
+        for arr in (self.original_targets, self.minibatch_targets):
+            if arr.mem is not None and device is not None:
+                arr.initialize(device)
+
+    def fill_minibatch(self):
+        super(FullBatchLoaderMSE, self).fill_minibatch()
+        targets, _ = gather_minibatch(self.original_targets.devmem,
+                                      self.minibatch_indices.devmem)
+        self.minibatch_targets.assign_devmem(targets)

@@ -1,0 +1,4 @@
+"""Model workflows — TPU-native counterparts of the Znicz samples
+(MNIST FC, MNIST conv, CIFAR convnet, AlexNet, MNIST autoencoder,
+Kohonen SOM; ``.coveragerc:51-66``, ``manualrst_veles_algorithms.rst``).
+"""

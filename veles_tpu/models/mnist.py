@@ -1,0 +1,151 @@
+"""MNIST fully-connected workflow — BASELINE config 1.
+
+The reference topology (Znicz MnistWorkflow: All2AllTanh 784→100 →
+All2AllSoftmax 100→10, EvaluatorSoftmax, DecisionGD, GDSoftmax+GDTanh,
+Repeater loop; published baseline 1.48% validation error,
+``manualrst_veles_algorithms.rst:32``) built the veles_tpu way. The
+same workflow object also powers the conv variant via ``layers`` config.
+
+Data comes from a pluggable provider so tests inject synthetic digits
+while production reads the real IDX files (see MnistIdxLoader).
+"""
+
+import gzip
+import os
+import struct
+
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.nn.all2all import All2AllSoftmax, All2AllTanh
+from veles_tpu.nn.decision import DecisionGD
+from veles_tpu.nn.evaluator import EvaluatorSoftmax
+from veles_tpu.nn.gd import GDSoftmax, GDTanh
+from veles_tpu.plumbing import Repeater
+
+
+def read_idx(path):
+    """Parse an (optionally gzipped) IDX file (MNIST's native format)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = numpy.frombuffer(f.read(), dtype=numpy.uint8)
+    return data.reshape(dims)
+
+
+class MnistLoader(FullBatchLoader):
+    """Full-batch loader over a provider callable returning
+    (train_data, train_labels, valid_data, valid_labels)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, provider=None, **kwargs):
+        kwargs.setdefault("normalization_type", "linear")
+        super(MnistLoader, self).__init__(workflow, **kwargs)
+        self.provider = provider
+
+    def load_dataset(self):
+        train_x, train_y, valid_x, valid_y = self.provider()
+        data = numpy.concatenate([valid_x, train_x], axis=0).astype(
+            numpy.float32)
+        labels = numpy.concatenate([valid_y, train_y], axis=0).astype(
+            numpy.int32)
+        self.original_data.reset(data.reshape(len(data), -1))
+        self.original_labels.reset(labels)
+        self.class_lengths = [0, len(valid_x), len(train_x)]
+
+
+def mnist_idx_provider(directory):
+    """Provider reading the standard 4 MNIST IDX files from a directory
+    (t10k = validation, following the reference's split)."""
+    def provide():
+        def grab(stem):
+            for name in (stem, stem + ".gz"):
+                path = os.path.join(directory, name)
+                if os.path.exists(path):
+                    return read_idx(path)
+            raise FileNotFoundError(stem)
+        return (grab("train-images-idx3-ubyte"),
+                grab("train-labels-idx1-ubyte"),
+                grab("t10k-images-idx3-ubyte"),
+                grab("t10k-labels-idx1-ubyte"))
+    return provide
+
+
+class MnistWorkflow(AcceleratedWorkflow):
+    """784 → layers... → 10 softmax classifier with the Znicz loop."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, provider=None, layers=(100,),
+                 minibatch_size=60, learning_rate=0.1, weights_decay=0.0,
+                 max_epochs=None, fail_iterations=100, **kwargs):
+        super(MnistWorkflow, self).__init__(workflow, **kwargs)
+
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+
+        self.loader = MnistLoader(self, provider=provider,
+                                  minibatch_size=minibatch_size,
+                                  name="MnistLoader")
+        self.loader.link_from(self.repeater)
+
+        # forward chain
+        self.forwards = []
+        src = self.loader
+        src_attr = "minibatch_data"
+        for width in layers:
+            fwd = All2AllTanh(self, output_sample_shape=(width,),
+                              name="fc%d" % len(self.forwards))
+            fwd.link_from(src if not self.forwards else self.forwards[-1])
+            fwd.link_attrs(src if not self.forwards else self.forwards[-1],
+                           ("input", src_attr))
+            self.forwards.append(fwd)
+            src_attr = "output"
+        head = All2AllSoftmax(self, output_sample_shape=(10,),
+                              name="softmax")
+        prev = self.forwards[-1] if self.forwards else self.loader
+        head.link_from(prev)
+        head.link_attrs(prev, ("input", src_attr))
+        self.forwards.append(head)
+
+        # evaluator + decision
+        self.evaluator = EvaluatorSoftmax(self, name="evaluator")
+        self.evaluator.link_from(head)
+        self.evaluator.link_attrs(head, "output")
+        self.evaluator.link_attrs(self.loader,
+                                  ("labels", "minibatch_labels"))
+
+        self.decision = DecisionGD(self, max_epochs=max_epochs,
+                                   fail_iterations=fail_iterations,
+                                   name="decision")
+        self.decision.link_from(self.evaluator)
+        self.decision.link_attrs(self.loader, "minibatch_class",
+                                 "last_minibatch", "epoch_ended",
+                                 "epoch_number", "class_lengths",
+                                 "minibatch_size")
+        self.decision.link_attrs(self.evaluator,
+                                 ("minibatch_n_err", "n_err"))
+
+        # backward chain (reverse order), gated off non-train minibatches
+        self.gds = []
+        err_src, err_attr = self.evaluator, "err_output"
+        for fwd in reversed(self.forwards):
+            gd_cls = GDSoftmax if fwd is head else GDTanh
+            gd = gd_cls(self, forward=fwd, learning_rate=learning_rate,
+                        weights_decay=weights_decay,
+                        need_err_input=fwd is not self.forwards[0],
+                        name="gd_" + fwd.name)
+            gd.link_from(self.gds[-1] if self.gds else self.decision)
+            gd.link_attrs(err_src, ("err_output", err_attr))
+            gd.gate_skip = self.decision.gd_skip
+            self.gds.append(gd)
+            err_src, err_attr = gd, "err_input"
+
+        self.repeater.link_from(self.gds[-1])
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete

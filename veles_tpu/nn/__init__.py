@@ -1,0 +1,28 @@
+"""Neural-network unit library — the TPU-native Znicz replacement.
+
+The reference's NN engine lived in the (absent) ``veles/znicz`` submodule:
+All2All/Conv/Pooling forward units, GradientDescent* backward units,
+activations, evaluators, Decision, Kohonen, dropout, LRN (SURVEY.md §2,
+``docs/source/manualrst_veles_algorithms.rst``). Here each forward unit
+owns a *pure function* ``apply(params, x)``; backward units derive their
+math from the forward via ``jax.vjp`` (no hand-written gradients), and
+the step compiler (:mod:`veles_tpu.train`) composes the same pure
+functions into one jitted train step for the TPU hot loop.
+"""
+
+from veles_tpu.nn.all2all import (All2All, All2AllRELU, All2AllSigmoid,  # noqa
+                                  All2AllSoftmax, All2AllTanh)
+from veles_tpu.nn.activation import ActivationUnit  # noqa: F401
+from veles_tpu.nn.conv import Conv, ConvRELU, ConvSigmoid, ConvTanh  # noqa
+from veles_tpu.nn.pooling import AvgPooling, MaxPooling  # noqa: F401
+from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax  # noqa
+from veles_tpu.nn.gd import (GradientDescent, GDActivation, GDConv,  # noqa
+                             GDPooling, GDSoftmax, GDTanh, GDRELU,
+                             GDSigmoid)
+from veles_tpu.nn.decision import DecisionGD, DecisionMSE  # noqa: F401
+from veles_tpu.nn.dropout import DropoutBackward, DropoutForward  # noqa
+
+#: Znicz name for the dropout backward unit
+GDDropout = DropoutBackward
+from veles_tpu.nn.normalization import LRNormalizerForward  # noqa: F401
+from veles_tpu.nn.kohonen import KohonenForward, KohonenTrainer  # noqa: F401

@@ -1,0 +1,98 @@
+"""Activation functions + standalone activation units.
+
+Mirrors the Znicz activation family (``manualrst_veles_algorithms.rst``
+"Extras": tanh/sigmoid/RELU/strict RELU/log/mul). Derivatives are never
+hand-written — backward units use ``jax.vjp`` over these functions.
+The reference's scaled tanh (1.7159 * tanh(2/3 x), the classic LeCun
+variant used by Znicz All2AllTanh) is kept bit-for-bit.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.nn.base import ForwardBase
+
+
+def linear(x):
+    return x
+
+def tanh_scaled(x):
+    """LeCun-scaled tanh used by Znicz All2AllTanh."""
+    return 1.7159 * jnp.tanh(0.6666 * x)
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+def relu_soft(x):
+    """Znicz's default "RELU": log(1 + exp(x)) (softplus)."""
+    return jnp.where(x > 15.0, x, jnp.log1p(jnp.exp(jnp.minimum(x, 15.0))))
+
+def relu_strict(x):
+    return jnp.maximum(x, 0.0)
+
+def leaky_relu(x, alpha=0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+def log_activation(x):
+    return jnp.log(x + jnp.sqrt(jnp.square(x) + 1.0))
+
+def sincos(x):
+    """Znicz ActivationSinCos: odd features sin, even features cos."""
+    idx = jnp.arange(x.shape[-1])
+    return jnp.where(idx % 2 == 1, jnp.sin(x), jnp.cos(x))
+
+def mul_by_const(x, k=1.0):
+    return x * k
+
+
+ACTIVATIONS = {
+    "linear": linear,
+    "tanh": tanh_scaled,
+    "sigmoid": sigmoid,
+    "relu": relu_soft,
+    "strict_relu": relu_strict,
+    "leaky_relu": leaky_relu,
+    "log": log_activation,
+    "sincos": sincos,
+}
+
+
+def get_activation(name):
+    if callable(name):
+        return name
+    try:
+        return ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError("unknown activation %r (have: %s)" %
+                         (name, sorted(ACTIVATIONS)))
+
+
+class ActivationUnit(ForwardBase):
+    """Standalone elementwise activation unit (no weights)."""
+
+    def __init__(self, workflow, activation="linear", **kwargs):
+        kwargs.setdefault("include_bias", False)
+        super(ActivationUnit, self).__init__(workflow, **kwargs)
+        self.activation_name = (activation if isinstance(activation, str)
+                                else activation.__name__)
+        self._activation = get_activation(activation)
+
+    @property
+    def has_weights(self):
+        return False
+
+    def output_shape_for(self, input_shape):
+        return input_shape
+
+    def apply(self, params, x):
+        return self._activation(x)
+
+    def init_unpickled(self):
+        super(ActivationUnit, self).init_unpickled()
+        if hasattr(self, "activation_name"):
+            self._activation = get_activation(self.activation_name)
+
+    def __getstate__(self):
+        state = super(ActivationUnit, self).__getstate__()
+        state.pop("_activation", None)
+        return state

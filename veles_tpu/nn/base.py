@@ -1,0 +1,150 @@
+"""Base classes for NN forward units.
+
+A forward unit owns parameters (``weights``/``bias`` as
+:class:`~veles_tpu.memory.Array`) and a **pure** ``apply(params, x)``.
+Eager execution jits ``apply`` per static shape; the step compiler
+(:mod:`veles_tpu.train`) reuses the same ``apply`` to build one fused
+train step — the unit graph is the model *description*, the compiled
+step is the model *execution* (the semantic-gap resolution flagged in
+SURVEY.md §7 "hard parts").
+
+Weight initialization follows the reference's filler contract
+(``weights_stddev``-style uniform fill from the seeded PRNG registry) so
+CPU/TPU runs starting from the same seed produce identical curves.
+"""
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+
+
+class ForwardBase(AcceleratedUnit):
+    """Base forward unit: input -> output through pure ``apply``."""
+
+    hide_from_registry = True
+    view_group = "WORKER"
+    # weight init legitimately advances the global RNG stream — without
+    # this, Unit._initialize_wrapped restores the stream and same-shape
+    # layers would start bit-identical
+    consumes_global_rng_on_init = True
+
+    def __init__(self, workflow, **kwargs):
+        self.include_bias = kwargs.pop("include_bias", True)
+        self.weights_stddev = kwargs.pop("weights_stddev", None)
+        self.bias_stddev = kwargs.pop("bias_stddev", None)
+        self.weights_filling = kwargs.pop("weights_filling", "uniform")
+        self.bias_filling = kwargs.pop("bias_filling", "uniform")
+        self.rand_name = kwargs.pop("rand", "default")
+        super(ForwardBase, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.output = Array()
+        self.weights = Array()
+        self.bias = Array()
+        self.demand("input")
+
+    # -- to override -------------------------------------------------------
+
+    @property
+    def has_weights(self):
+        return True
+
+    def weights_shape_for(self, input_shape):
+        raise NotImplementedError
+
+    def bias_shape_for(self, input_shape):
+        raise NotImplementedError
+
+    def output_shape_for(self, input_shape):
+        raise NotImplementedError
+
+    def apply(self, params, x):
+        """Pure function: params dict + input batch -> output batch."""
+        raise NotImplementedError
+
+    def apply_for_grad(self, params, x):
+        """The function the paired GD unit differentiates. Defaults to
+        :meth:`apply`; softmax heads return logits instead (the
+        evaluator seeds the gradient w.r.t. logits)."""
+        return self.apply(params, x)
+
+    # -- parameter handling ------------------------------------------------
+
+    def fill_weights(self):
+        rng = prng.get(self.rand_name)
+        shape = self.weights.shape
+        fan_in = int(numpy.prod(shape[:-1])) if len(shape) > 1 else shape[0]
+        stddev = self.weights_stddev or 1.0 / numpy.sqrt(max(fan_in, 1))
+        if self.weights_filling == "gaussian":
+            rng.fill_normal(self.weights.mem, 0.0, stddev)
+        else:
+            rng.fill(self.weights.mem, -stddev, stddev)
+        if self.include_bias and self.bias.mem is not None:
+            bstd = self.bias_stddev or stddev
+            if self.bias_filling == "gaussian":
+                rng.fill_normal(self.bias.mem, 0.0, bstd)
+            elif self.bias_filling == "constant":
+                self.bias.mem[...] = bstd
+            else:
+                rng.fill(self.bias.mem, -bstd, bstd)
+
+    def param_values(self):
+        """Device-side parameter pytree for ``apply``."""
+        params = {}
+        if self.has_weights:
+            params["weights"] = self.weights.devmem
+            if self.include_bias:
+                params["bias"] = self.bias.devmem
+        return params
+
+    def param_arrays(self):
+        out = {}
+        if self.has_weights:
+            out["weights"] = self.weights
+            if self.include_bias:
+                out["bias"] = self.bias
+        return out
+
+    @property
+    def input_shape(self):
+        mem = self.input.mem if isinstance(self.input, Array) else self.input
+        return tuple(mem.shape)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def initialize(self, device=None, **kwargs):
+        super(ForwardBase, self).initialize(device=device, **kwargs)
+        in_shape = self.input_shape
+        dtype = numpy.float32
+        if self.has_weights and self.weights.mem is None:
+            self.weights.reset(numpy.zeros(self.weights_shape_for(in_shape),
+                                           dtype))
+            if self.include_bias:
+                self.bias.reset(numpy.zeros(self.bias_shape_for(in_shape),
+                                            dtype))
+            self.fill_weights()
+        out_shape = self.output_shape_for(in_shape)
+        if self.output.mem is None or tuple(self.output.shape) != out_shape:
+            self.output.reset(numpy.zeros(out_shape, dtype))
+        self.init_vectors(self.input, self.output, self.weights, self.bias)
+
+    # -- execution ---------------------------------------------------------
+
+    def _input_devmem(self):
+        return (self.input.devmem if isinstance(self.input, Array)
+                else self.input)
+
+    def jax_run(self):
+        self.unmap_vectors(self.input, self.weights, self.bias)
+        fwd = self.jit(self.apply)
+        self.output.assign_devmem(fwd(self.param_values(),
+                                      self._input_devmem()))
+
+    def numpy_run(self):
+        # the numpy pseudo-device evaluates the same pure function on
+        # host buffers (jax-on-CPU under the hood): one math source
+        params = {k: v.mem for k, v in self.param_arrays().items()}
+        x = self.input.mem if isinstance(self.input, Array) else self.input
+        self.output.map_invalidate()[...] = numpy.asarray(
+            self.apply(params, x))

@@ -1,0 +1,139 @@
+"""Convolutional forward units.
+
+Znicz Conv (+Tanh/RELU/Sigmoid variants): NHWC layout (the TPU-native
+layout — channels last rides the 128-lane dimension), weights HWIO,
+lowered through ``lax.conv_general_dilated`` so XLA tiles it onto the
+MXU. Supports stride, symmetric padding, and channel-preserving groups.
+"""
+
+import jax.lax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.nn.activation import get_activation
+from veles_tpu.nn.base import ForwardBase
+
+
+class Conv(ForwardBase):
+    """NHWC convolution: y = act(conv(x, W) + b)."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, n_kernels=None, kx=None, ky=None, **kwargs):
+        if None in (n_kernels, kx, ky):
+            raise ValueError("Conv needs n_kernels, kx, ky")
+        self.n_kernels = n_kernels
+        self.kx, self.ky = kx, ky
+        self.sliding = tuple(kwargs.pop("sliding", (1, 1)))
+        self.padding = kwargs.pop("padding", "VALID")
+        self.activation_name = kwargs.pop("activation", self.ACTIVATION)
+        super(Conv, self).__init__(workflow, **kwargs)
+
+    def _channels(self, input_shape):
+        if len(input_shape) == 3:
+            return 1
+        return input_shape[3]
+
+    def weights_shape_for(self, input_shape):
+        # HWIO
+        return (self.ky, self.kx, self._channels(input_shape),
+                self.n_kernels)
+
+    def bias_shape_for(self, input_shape):
+        return (self.n_kernels,)
+
+    def _pad_pairs(self):
+        if isinstance(self.padding, str):
+            return self.padding
+        if isinstance(self.padding, int):
+            p = self.padding
+            return ((p, p), (p, p))
+        if len(self.padding) == 2:
+            return ((self.padding[0], self.padding[0]),
+                    (self.padding[1], self.padding[1]))
+        # reference 4-tuple (left, top, right, bottom)
+        left, top, right, bottom = self.padding
+        return ((top, bottom), (left, right))
+
+    def output_shape_for(self, input_shape):
+        # abstract evaluation only: no compilation, no execution
+        import jax
+        x = jax.ShapeDtypeStruct((1,) + tuple(input_shape[1:]),
+                                 jnp.float32)
+        w = jax.ShapeDtypeStruct(self.weights_shape_for(input_shape),
+                                 jnp.float32)
+        y = jax.eval_shape(self.apply, {"weights": w}, x)
+        return (input_shape[0],) + tuple(y.shape[1:])
+
+    def apply(self, params, x):
+        if x.ndim == 3:
+            x = x[..., None]  # grayscale -> NHWC
+        y = jax.lax.conv_general_dilated(
+            x.astype(jnp.float32), params["weights"].astype(jnp.float32),
+            window_strides=(self.sliding[1], self.sliding[0]),
+            padding=self._pad_pairs(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        if "bias" in params:
+            y = y + params["bias"]
+        return get_activation(self.activation_name)(y)
+
+
+class ConvTanh(Conv):
+    ACTIVATION = "tanh"
+
+
+class ConvRELU(Conv):
+    ACTIVATION = "relu"
+
+
+class ConvStrictRELU(Conv):
+    ACTIVATION = "strict_relu"
+
+
+class ConvSigmoid(Conv):
+    ACTIVATION = "sigmoid"
+
+
+class Deconv(ForwardBase):
+    """Transposed convolution (Znicz Deconv, used by conv autoencoders)."""
+
+    def __init__(self, workflow, n_kernels=None, kx=None, ky=None, **kwargs):
+        if None in (n_kernels, kx, ky):
+            raise ValueError("Deconv needs n_kernels, kx, ky")
+        self.n_kernels = n_kernels  # = channels of the OUTPUT
+        self.kx, self.ky = kx, ky
+        self.sliding = tuple(kwargs.pop("sliding", (1, 1)))
+        self.padding = kwargs.pop("padding", "VALID")
+        kwargs.setdefault("include_bias", False)
+        super(Deconv, self).__init__(workflow, **kwargs)
+
+    def weights_shape_for(self, input_shape):
+        return (self.ky, self.kx, self.n_kernels, input_shape[3]
+                if len(input_shape) == 4 else 1)
+
+    def bias_shape_for(self, input_shape):
+        return (self.n_kernels,)
+
+    def output_shape_for(self, input_shape):
+        import jax
+        x = jax.ShapeDtypeStruct((1,) + tuple(input_shape[1:]),
+                                 jnp.float32)
+        w = jax.ShapeDtypeStruct(self.weights_shape_for(input_shape),
+                                 jnp.float32)
+        y = jax.eval_shape(self.apply, {"weights": w}, x)
+        return (input_shape[0],) + tuple(y.shape[1:])
+
+    def apply(self, params, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        y = jax.lax.conv_transpose(
+            x.astype(jnp.float32), params["weights"].astype(jnp.float32),
+            strides=(self.sliding[1], self.sliding[0]),
+            padding=self.padding if isinstance(self.padding, str)
+            else [(p, p) for p in (self.padding, self.padding)]
+            if isinstance(self.padding, int) else self.padding,
+            dimension_numbers=("NHWC", "HWOI", "NHWC"))
+        if "bias" in params:
+            y = y + params["bias"]
+        return y

@@ -1,0 +1,147 @@
+"""Decision units: epoch accounting + stop criterion + GD gating.
+
+The Znicz Decision unit is the control heart of every reference
+workflow: it accumulates per-class epoch statistics from the evaluator,
+decides when training is complete (max epochs, or no validation
+improvement for ``fail_iterations`` epochs), exposes ``gd_skip`` so
+gradient units only run on TRAIN minibatches, and raises ``improved``
+for the snapshotter. Topology contract (mirrors Znicz MnistWorkflow):
+
+    repeater -> loader -> forwards... -> evaluator -> decision
+    decision -> gd[n] -> ... -> gd[0] -> repeater
+    end_point.link_from(decision); end_point.gate_block = ~complete
+    gd[i].gate_skip = decision.gd_skip
+"""
+
+import numpy
+
+from veles_tpu.loader.base import TRAIN, VALIDATION, CLASS_NAMES
+from veles_tpu.mutable import Bool
+from veles_tpu.result_provider import IResultProvider
+from veles_tpu.units import Unit
+
+
+class DecisionBase(Unit, IResultProvider):
+    hide_from_registry = True
+    view_group = "TRAINER"
+
+    #: lower is better for these metrics
+    METRIC_NAME = "n_err"
+
+    def __init__(self, workflow, **kwargs):
+        self.max_epochs = kwargs.pop("max_epochs", None)
+        self.fail_iterations = kwargs.pop("fail_iterations", 100)
+        super(DecisionBase, self).__init__(workflow, **kwargs)
+        self.complete = Bool(False)
+        self.improved = Bool(False)
+        self.gd_skip = Bool(False)
+        self.epoch_stats = [dict() for _ in range(3)]
+        self.epoch_history = []
+        self.best_metric = numpy.inf
+        self.best_epoch = -1
+        self.demand("minibatch_class", "last_minibatch", "epoch_ended",
+                    "epoch_number", "class_lengths", "minibatch_size")
+
+    def initialize(self, **kwargs):
+        self._reset_epoch()
+
+    def _reset_epoch(self):
+        for stats in self.epoch_stats:
+            stats.clear()
+            stats.update(samples=0, metric=0.0)
+
+    # -- per-minibatch metric from the evaluator ---------------------------
+
+    def minibatch_metric(self):
+        """Metric value summed over this minibatch (lower = better)."""
+        raise NotImplementedError
+
+    def run(self):
+        klass = self.minibatch_class
+        self.gd_skip <<= (klass != TRAIN)
+        stats = self.epoch_stats[klass]
+        stats["samples"] += self.minibatch_size
+        stats["metric"] += self.minibatch_metric()
+        if bool(self.last_minibatch):
+            self._on_class_finished(klass)
+        if bool(self.epoch_ended):
+            self._on_epoch_finished()
+
+    def _on_class_finished(self, klass):
+        stats = self.epoch_stats[klass]
+        if not stats["samples"]:
+            return
+        normalized = stats["metric"] / stats["samples"]
+        stats["normalized"] = normalized
+        if klass == VALIDATION or (klass == TRAIN and
+                                   not self.class_lengths[VALIDATION]):
+            self.improved <<= normalized < self.best_metric
+            if bool(self.improved):
+                self.best_metric = normalized
+                self.best_epoch = self.epoch_number
+
+    def _on_epoch_finished(self):
+        summary = {CLASS_NAMES[i]: dict(self.epoch_stats[i])
+                   for i in range(3) if self.class_lengths[i]}
+        summary["epoch"] = self.epoch_number
+        self.epoch_history.append(summary)
+        self.info("epoch %d: %s", self.epoch_number, "  ".join(
+            "%s %s=%.4f" % (CLASS_NAMES[i], self.METRIC_NAME,
+                            self.epoch_stats[i].get("normalized",
+                                                    numpy.nan))
+            for i in range(3) if self.class_lengths[i]))
+        stop = False
+        if self.max_epochs is not None and \
+                self.epoch_number + 1 >= self.max_epochs:
+            self.info("stopping: max_epochs=%d reached", self.max_epochs)
+            stop = True
+        if self.epoch_number - self.best_epoch > self.fail_iterations:
+            self.info("stopping: no improvement in %d epochs",
+                      self.fail_iterations)
+            stop = True
+        if stop:
+            self.complete <<= True
+        self._reset_epoch()
+
+    def get_metric_values(self):
+        return {"best_%s" % self.METRIC_NAME: float(self.best_metric),
+                "best_epoch": self.best_epoch,
+                "epochs": len(self.epoch_history)}
+
+
+class DecisionGD(DecisionBase):
+    """Classification: metric = misclassification count / samples."""
+
+    METRIC_NAME = "n_err_pt"
+
+    def __init__(self, workflow, **kwargs):
+        super(DecisionGD, self).__init__(workflow, **kwargs)
+        self.demand("minibatch_n_err")
+
+    def minibatch_metric(self):
+        return float(self.minibatch_n_err)
+
+
+class DecisionMSE(DecisionBase):
+    """Regression/AE: metric = summed per-sample MSE."""
+
+    METRIC_NAME = "rmse"
+
+    def __init__(self, workflow, **kwargs):
+        super(DecisionMSE, self).__init__(workflow, **kwargs)
+        self.demand("minibatch_mse")
+
+    def minibatch_metric(self):
+        mse = self.minibatch_mse
+        if hasattr(mse, "__len__"):
+            return float(numpy.sum(
+                numpy.asarray(mse)[:self.minibatch_size]))
+        return float(mse) * self.minibatch_size
+
+    def _on_class_finished(self, klass):
+        stats = self.epoch_stats[klass]
+        if stats["samples"]:
+            # report RMSE, compare on MSE (monotonic — same argmin)
+            stats["metric_rmse"] = float(
+                numpy.sqrt(stats["metric"] / stats["samples"]))
+        super(DecisionMSE, self)._on_class_finished(klass)

@@ -1,0 +1,82 @@
+"""Dropout units (Znicz dropout.py: DropoutForward/DropoutBackward).
+
+The forward draws an inverted-dropout mask from the unit's deterministic
+JAX key chain (so snapshots resume the exact stream — the reference kept
+xorshift states for the same reason); the backward reuses the *stored*
+mask, which is why these two override the generic vjp machinery.
+"""
+
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.memory import Array
+from veles_tpu.nn.base import ForwardBase
+from veles_tpu.nn.gd import GradientDescentBase
+from veles_tpu.ops.random import uniform
+
+
+class DropoutForward(ForwardBase):
+    """Inverted dropout: y = x * mask / (1 - p); identity when testing."""
+
+    def __init__(self, workflow, dropout_ratio=0.5, **kwargs):
+        kwargs.setdefault("include_bias", False)
+        super(DropoutForward, self).__init__(workflow, **kwargs)
+        self.dropout_ratio = dropout_ratio
+        self.testing = False
+        self.last_mask = None
+
+    @property
+    def has_weights(self):
+        return False
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def apply(self, params, x):
+        if self.testing or self.last_mask is None:
+            return x
+        return x * self.last_mask
+
+    def _draw_mask(self, shape):
+        key = prng.get(self.rand_name).jax_key()
+        keep = 1.0 - self.dropout_ratio
+        u = uniform(key, tuple(shape))
+        return (u < keep).astype(jnp.float32) / keep
+
+    def jax_run(self):
+        x = self._input_devmem()
+        if self.testing:
+            self.last_mask = None
+            self.output.assign_devmem(x)
+            return
+        self.last_mask = self._draw_mask(x.shape)
+        self.output.assign_devmem(x * self.last_mask)
+
+    def numpy_run(self):
+        x = self.input.mem if isinstance(self.input, Array) else self.input
+        if self.testing:
+            self.last_mask = None
+            self.output.map_invalidate()[...] = x
+            return
+        self.last_mask = numpy.asarray(self._draw_mask(x.shape))
+        self.output.map_invalidate()[...] = x * self.last_mask
+
+
+class DropoutBackward(GradientDescentBase):
+    """err_input = err_output * stored forward mask.
+
+    NOT the generic vjp path: the mask changes every forward run, so it
+    must be read at run time, never baked into a jitted closure."""
+
+    def jax_run(self):
+        fwd = self.forward
+        err_out = (self.err_output.devmem
+                   if isinstance(self.err_output, Array)
+                   else self.err_output)
+        if fwd.last_mask is None:
+            self.err_input.assign_devmem(err_out)
+        else:
+            self.err_input.assign_devmem(err_out * fwd.last_mask)
+
+    numpy_run = jax_run

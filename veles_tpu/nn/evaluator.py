@@ -1,0 +1,152 @@
+"""Evaluator units: turn forward output + ground truth into loss,
+error counts and the backward seed (``err_output``).
+
+Znicz contract: EvaluatorSoftmax feeds GDSoftmax with
+``err_output = probs - onehot(target)`` (the gradient w.r.t. the
+pre-softmax logits — which is why GDSoftmax differentiates only the
+linear part), plus ``n_err`` (misclassification count) and a confusion
+matrix; EvaluatorMSE feeds plain GD with ``output - target``.
+
+Batch normalization of the gradient (1/batch) is applied here so the
+learning rate means the same thing at any minibatch size.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+from veles_tpu.result_provider import IResultProvider
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes",
+                                             "compute_confusion"))
+def _softmax_eval(probs, labels, n_classes, compute_confusion=True):
+    batch = probs.shape[0]
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    onehot = jax.nn.one_hot(safe, n_classes, dtype=probs.dtype)
+    err = (probs - onehot) * valid[:, None] / batch
+    pred = jnp.argmax(probs, axis=1)
+    n_err = jnp.sum((pred != safe) & valid)
+    p_true = jnp.take_along_axis(probs, safe[:, None], axis=1)[:, 0]
+    loss = -jnp.sum(jnp.log(jnp.maximum(p_true, 1e-30)) * valid) \
+        / jnp.maximum(jnp.sum(valid), 1)
+    confusion = None
+    if compute_confusion:
+        flat = safe * n_classes + pred
+        confusion = jnp.zeros((n_classes * n_classes,), jnp.int32).at[
+            flat].add(valid.astype(jnp.int32)).reshape(n_classes, n_classes)
+    max_err_sum = jnp.max(jnp.sum(jnp.abs(err), axis=1))
+    return err, n_err, loss, confusion, max_err_sum
+
+
+@jax.jit
+def _mse_eval(output, target, valid=None):
+    batch = output.shape[0]
+    diff = output.reshape(batch, -1) - target.reshape(batch, -1)
+    if valid is None:
+        n_valid = jnp.float32(batch)
+        vmask = jnp.ones((batch, 1), output.dtype)
+    else:
+        vmask = valid.astype(output.dtype)[:, None]
+        n_valid = jnp.maximum(jnp.sum(vmask), 1.0)
+    diff = diff * vmask  # phantom padded rows contribute nothing
+    err = diff / n_valid
+    mse_per_sample = jnp.mean(jnp.square(diff), axis=1)
+    return err, jnp.sqrt(jnp.sum(mse_per_sample) / n_valid), mse_per_sample
+
+
+class EvaluatorBase(AcceleratedUnit, IResultProvider):
+    hide_from_registry = True
+    view_group = "EVALUATOR"
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorBase, self).__init__(workflow, **kwargs)
+        self.output = None         # linked from the head forward unit
+        self.err_output = Array()  # consumed by the GD chain
+        self.testing = kwargs.get("testing", False)
+        self.demand("output")
+
+    def initialize(self, device=None, **kwargs):
+        super(EvaluatorBase, self).initialize(device=device, **kwargs)
+        out = self.output
+        mem = out.mem if isinstance(out, Array) else out
+        self.err_output.reset(numpy.zeros(mem.shape, numpy.float32))
+        self.init_vectors(self.err_output)
+
+    def _output_devmem(self):
+        return (self.output.devmem if isinstance(self.output, Array)
+                else self.output)
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Cross-entropy over a softmax head."""
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorSoftmax, self).__init__(workflow, **kwargs)
+        self.labels = None  # linked from loader (minibatch_labels)
+        self.n_err = 0
+        self.loss = 0.0
+        self.max_err_output_sum = 0.0
+        self.confusion_matrix = None
+        self.compute_confusion = kwargs.get("compute_confusion", True)
+        self.demand("labels")
+
+    def jax_run(self):
+        probs = self._output_devmem()
+        labels = (self.labels.devmem if isinstance(self.labels, Array)
+                  else jnp.asarray(self.labels))
+        n_classes = probs.shape[-1]
+        err, n_err, loss, confusion, max_err = _softmax_eval(
+            probs.reshape(probs.shape[0], -1), labels, n_classes,
+            self.compute_confusion)
+        if not self.testing:
+            self.err_output.assign_devmem(err.reshape(
+                self.err_output.shape))
+        self.n_err = int(n_err)
+        self.loss = float(loss)
+        self.max_err_output_sum = float(max_err)
+        if confusion is not None:
+            self.confusion_matrix = numpy.asarray(confusion)
+
+    numpy_run = jax_run  # same math through jax-on-host
+
+    def get_metric_values(self):
+        return {"n_err": self.n_err, "loss": self.loss}
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean-squared-error head (autoencoders, regression)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorMSE, self).__init__(workflow, **kwargs)
+        self.target = None   # linked from loader (minibatch_targets)
+        self.indices = None  # optional link: loader minibatch_indices
+        self.rmse = 0.0
+        self.mse_per_sample = None
+        self.demand("target")
+
+    def jax_run(self):
+        out = self._output_devmem()
+        target = (self.target.devmem if isinstance(self.target, Array)
+                  else jnp.asarray(self.target))
+        valid = None
+        if self.indices is not None:
+            idx = (self.indices.devmem if isinstance(self.indices, Array)
+                   else jnp.asarray(self.indices))
+            valid = idx >= 0  # padded tail rows are masked out
+        err, rmse, per_sample = _mse_eval(out, target, valid)
+        if not self.testing:
+            self.err_output.assign_devmem(
+                err.reshape(self.err_output.shape))
+        self.rmse = float(rmse)
+        self.mse_per_sample = numpy.asarray(per_sample)
+
+    numpy_run = jax_run
+
+    def get_metric_values(self):
+        return {"rmse": self.rmse}

@@ -1,0 +1,126 @@
+"""Kohonen self-organizing map units (the reference's Kohonen sample —
+``manualrst_veles_algorithms.rst`` and ``.coveragerc:51-66``).
+
+Forward: winner index per sample (argmin distance to codebook).
+Trainer: classic SOM update with a Gaussian neighborhood over the 2-D
+grid and decaying radius/learning rate — expressed as one jitted batch
+update (winner search + neighborhood-weighted pull in a single XLA
+computation) instead of the reference's per-sample kernel loop.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _winners(codebook, x):
+    # pairwise squared distances: |c|^2 - 2 x.c  (|x|^2 constant per row)
+    dots = jnp.dot(x, codebook.T, preferred_element_type=jnp.float32)
+    c2 = jnp.sum(jnp.square(codebook), axis=1)
+    return jnp.argmin(c2[None, :] - 2.0 * dots, axis=1)
+
+
+@jax.jit
+def _som_update(codebook, x, grid, sigma, lr):
+    win = _winners(codebook, x)                       # (batch,)
+    win_pos = jnp.take(grid, win, axis=0)             # (batch, 2)
+    d2 = jnp.sum(jnp.square(grid[None, :, :] -
+                            win_pos[:, None, :]), axis=2)
+    h = jnp.exp(-d2 / (2.0 * sigma * sigma))          # (batch, units)
+    num = jnp.dot(h.T, x, preferred_element_type=jnp.float32)
+    den = jnp.sum(h, axis=0)[:, None]
+    delta = num - den * codebook
+    return codebook + lr * delta / x.shape[0], win
+
+
+class KohonenForward(AcceleratedUnit):
+    """Maps each input sample to its best-matching unit index."""
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenForward, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.weights = None  # linked from the trainer
+        self.output = Array()
+        self.demand("input", "weights")
+
+    def initialize(self, device=None, **kwargs):
+        super(KohonenForward, self).initialize(device=device, **kwargs)
+        batch = (self.input.shape if isinstance(self.input, Array)
+                 else self.input.shape)[0]
+        self.output.reset(numpy.zeros(batch, numpy.int32))
+
+    def jax_run(self):
+        x = (self.input.devmem if isinstance(self.input, Array)
+             else self.input)
+        w = (self.weights.devmem if isinstance(self.weights, Array)
+             else self.weights)
+        batch = x.shape[0]
+        self.output.assign_devmem(_winners(w, x.reshape(batch, -1)))
+
+    numpy_run = jax_run
+
+
+class KohonenTrainer(AcceleratedUnit):
+    """SOM codebook trainer over an sx × sy grid."""
+
+    consumes_global_rng_on_init = True  # codebook init advances the stream
+
+    def __init__(self, workflow, sx=8, sy=8, **kwargs):
+        self.sx, self.sy = sx, sy
+        self.sigma0 = kwargs.pop("sigma", max(sx, sy) / 2.0)
+        self.learning_rate = kwargs.pop("learning_rate", 0.5)
+        self.decay = kwargs.pop("decay", 0.005)
+        self.rand_name = kwargs.pop("rand", "default")
+        super(KohonenTrainer, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.weights = Array()
+        self.winners = Array()
+        self.time = 0
+        self.demand("input")
+
+    @property
+    def neurons_number(self):
+        return self.sx * self.sy
+
+    def initialize(self, device=None, **kwargs):
+        super(KohonenTrainer, self).initialize(device=device, **kwargs)
+        mem = (self.input.mem if isinstance(self.input, Array)
+               else self.input)
+        features = int(numpy.prod(mem.shape[1:]))
+        if self.weights.mem is None:
+            w = numpy.zeros((self.neurons_number, features), numpy.float32)
+            prng.get(self.rand_name).fill(w, -0.1, 0.1)
+            self.weights.reset(w)
+        gx, gy = numpy.meshgrid(numpy.arange(self.sx),
+                                numpy.arange(self.sy))
+        self._grid = numpy.stack(
+            [gx.ravel(), gy.ravel()], axis=1).astype(numpy.float32)
+        self.winners.reset(numpy.zeros(mem.shape[0], numpy.int32))
+        self.init_vectors(self.weights, self.winners)
+
+    def _schedule(self):
+        t = self.time
+        sigma = max(self.sigma0 * numpy.exp(-self.decay * t), 0.5)
+        lr = max(self.learning_rate * numpy.exp(-self.decay * t), 0.01)
+        return numpy.float32(sigma), numpy.float32(lr)
+
+    def jax_run(self):
+        x = (self.input.devmem if isinstance(self.input, Array)
+             else self.input)
+        batch = x.shape[0]
+        sigma, lr = self._schedule()
+        new_w, win = _som_update(self.weights.devmem,
+                                 x.reshape(batch, -1),
+                                 jnp.asarray(self._grid), sigma, lr)
+        self.weights.assign_devmem(new_w)
+        self.winners.assign_devmem(win)
+        self.time += 1
+
+    numpy_run = jax_run

@@ -1,0 +1,37 @@
+"""Local response normalization (Znicz normalization.py — the AlexNet
+cross-channel LRN). Pure function, so the generic vjp backward applies.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.nn.base import ForwardBase
+
+
+def lrn(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
+    """Cross-channel LRN over NHWC: AlexNet formula."""
+    sq = jnp.square(x)
+    half = n // 2
+    padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+    window = jax.lax.reduce_window(
+        padded, jnp.float32(0), jax.lax.add,
+        (1,) * (x.ndim - 1) + (n,), (1,) * x.ndim, "VALID")
+    return x / jnp.power(k + alpha * window, beta)
+
+
+class LRNormalizerForward(ForwardBase):
+    def __init__(self, workflow, k=2.0, alpha=1e-4, beta=0.75, n=5,
+                 **kwargs):
+        kwargs.setdefault("include_bias", False)
+        super(LRNormalizerForward, self).__init__(workflow, **kwargs)
+        self.k, self.alpha, self.beta, self.n = k, alpha, beta, n
+
+    @property
+    def has_weights(self):
+        return False
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def apply(self, params, x):
+        return lrn(x, self.k, self.alpha, self.beta, self.n)

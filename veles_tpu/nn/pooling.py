@@ -1,0 +1,82 @@
+"""Pooling units (Znicz MaxPooling / AvgPooling / MaxAbsPooling +
+Depooling for autoencoders), lowered via ``lax.reduce_window``. The
+reference records ``input_offset`` (argmax positions) for the backward
+pass; here ``jax.vjp`` of the same forward routes gradients to the max
+positions automatically, so no offset bookkeeping survives.
+"""
+
+import jax.lax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.nn.base import ForwardBase
+
+
+class PoolingBase(ForwardBase):
+    def __init__(self, workflow, kx=2, ky=2, **kwargs):
+        self.kx, self.ky = kx, ky
+        sliding = kwargs.pop("sliding", None)
+        self.sliding = tuple(sliding) if sliding else (kx, ky)
+        kwargs.setdefault("include_bias", False)
+        super(PoolingBase, self).__init__(workflow, **kwargs)
+
+    @property
+    def has_weights(self):
+        return False
+
+    def output_shape_for(self, input_shape):
+        import jax
+        x = jax.ShapeDtypeStruct((1,) + tuple(input_shape[1:]),
+                                 jnp.float32)
+        y = jax.eval_shape(self.apply, {}, x)
+        return (input_shape[0],) + tuple(y.shape[1:])
+
+    def _window(self):
+        return (1, self.ky, self.kx, 1)
+
+    def _strides(self):
+        return (1, self.sliding[1], self.sliding[0], 1)
+
+
+class MaxPooling(PoolingBase):
+    def apply(self, params, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, self._window(), self._strides(),
+            "VALID")
+
+
+class MaxAbsPooling(PoolingBase):
+    """Picks the value with max |value| in each window (Znicz variant)."""
+
+    def apply(self, params, x):
+        if x.ndim == 3:
+            x = x[..., None]
+
+        def select(a, b):
+            return jnp.where(jnp.abs(a) >= jnp.abs(b), a, b)
+
+        return jax.lax.reduce_window(
+            x, jnp.float32(0), select, self._window(), self._strides(),
+            "VALID")
+
+
+class AvgPooling(PoolingBase):
+    def apply(self, params, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        summed = jax.lax.reduce_window(
+            x, jnp.float32(0), jax.lax.add, self._window(), self._strides(),
+            "VALID")
+        return summed / float(self.kx * self.ky)
+
+
+class Depooling(PoolingBase):
+    """Nearest-neighbor upsampling — the AE inverse of AvgPooling."""
+
+    def apply(self, params, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = jnp.repeat(x, self.ky, axis=1)
+        return jnp.repeat(x, self.kx, axis=2)
