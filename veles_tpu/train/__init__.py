@@ -1,0 +1,14 @@
+"""Fused training execution (the TPU hot path).
+
+The reference re-enters Python per unit per minibatch; on TPU that
+pattern wastes the chip (SURVEY.md §7 "hard parts": the training-loop
+boundary). :class:`~veles_tpu.train.step.FusedTrainer` lowers a standard
+workflow (loader → forwards → evaluator → decision → gds) into jitted
+segment functions — ``lax.scan`` over a segment's minibatch index
+matrix, parameters donated across steps — so one epoch is a handful of
+device calls regardless of minibatch count. The unit graph remains the
+model *description* (and the parity/debug path); this is the model
+*execution*.
+"""
+
+from veles_tpu.train.step import FusedTrainer  # noqa: F401

@@ -1,0 +1,304 @@
+"""The step compiler: unit chain -> one XLA computation.
+
+Contract with model workflows (MnistWorkflow et al. follow it):
+
+* ``wf.loader``     — FullBatchLoader-like: device-resident
+  ``original_data``/``original_labels``(/``original_targets``),
+  ``shuffled_indices``, ``class_lengths``, ``max_minibatch_size``;
+* ``wf.forwards``   — ordered ForwardBase list (pure ``apply``);
+* ``wf.evaluator``  — EvaluatorSoftmax or EvaluatorMSE (selects loss);
+* ``wf.gds``        — GD units (reverse order), giving each layer's
+  solver + hyper-parameters;
+* ``wf.decision``   — stop criterion (max_epochs / fail_iterations).
+
+The compiled functions:
+
+* ``train_segment(params, states, idx_matrix)`` — ``lax.scan`` over
+  minibatches: gather → forward → loss → grad → per-layer solver
+  update. Params/opt-states are donated, so weights stay in HBM across
+  the whole segment with zero host traffic;
+* ``eval_segment(params, idx_matrix)`` — forward-only scan.
+
+Epoch order mirrors the eager path (validation before train), so loss
+curves are comparable run-to-run.
+
+Training math parity: gradients here are d(mean CE)/dθ with padded rows
+masked — identical to EvaluatorSoftmax's ``(p - onehot)/batch`` seed
+through the GD chain.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.loader.base import TEST, TRAIN, VALIDATION, CLASS_NAMES
+from veles_tpu.logger import Logger
+from veles_tpu.nn.dropout import DropoutForward
+from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
+from veles_tpu.nn.optim import get_solver
+
+
+class FusedTrainer(Logger):
+    """Compiles and drives the fused train/eval loop of a workflow."""
+
+    def __init__(self, workflow, donate=True):
+        super(FusedTrainer, self).__init__()
+        self.workflow = workflow
+        self.loader = workflow.loader
+        self.forwards = list(workflow.forwards)
+        self.evaluator = workflow.evaluator
+        self.decision = workflow.decision
+        self.donate = donate
+        # map each forward to its GD unit (for solver + hyper)
+        self.gd_for = {}
+        for gd in getattr(workflow, "gds", []):
+            self.gd_for[id(gd.forward)] = gd
+        self._build()
+
+    # -- pure functions ----------------------------------------------------
+
+    def _forward(self, params_list, x, key, train):
+        """Run the forward chain; the head uses apply_for_grad (logits)."""
+        for i, fwd in enumerate(self.forwards):
+            is_head = i == len(self.forwards) - 1
+            if isinstance(fwd, DropoutForward):
+                if train:
+                    keep = 1.0 - fwd.dropout_ratio
+                    sub = jax.random.fold_in(key, i)
+                    mask = (jax.random.uniform(sub, x.shape) < keep)
+                    x = x * mask.astype(x.dtype) / keep
+            elif is_head:
+                x = fwd.apply_for_grad(params_list[i], x)
+            else:
+                x = fwd.apply(params_list[i], x)
+        return x
+
+    def _loss_and_metrics(self, out, labels_or_targets, valid):
+        """Returns (grad_loss, report_loss, metric).
+
+        ``grad_loss`` reproduces the eager evaluator's gradient seed
+        EXACTLY: softmax err is (p - onehot)/batch (full padded batch,
+        evaluator.py _softmax_eval), MSE err is diff/n_valid. The
+        human-facing ``report_loss`` normalizes by valid rows."""
+        batch = out.shape[0]
+        if self.loss_kind == "softmax":
+            labels = labels_or_targets
+            safe = jnp.where(valid, labels, 0)
+            logp = jax.nn.log_softmax(out.reshape(batch, -1))
+            picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+            n_valid = jnp.maximum(jnp.sum(valid), 1)
+            grad_loss = -jnp.sum(picked * valid) / batch
+            report_loss = -jnp.sum(picked * valid) / n_valid
+            pred = jnp.argmax(logp, axis=1)
+            n_err = jnp.sum((pred != safe) & valid)
+            return grad_loss, report_loss, n_err
+        # mse: eager err_output = diff/n_valid -> loss 0.5*sum(d^2)/n_valid
+        target = labels_or_targets
+        diff = (out.reshape(batch, -1) -
+                target.reshape(target.shape[0], -1))
+        diff = diff * valid[:, None]
+        n_valid = jnp.maximum(jnp.sum(valid), 1)
+        grad_loss = 0.5 * jnp.sum(jnp.square(diff)) / n_valid
+        # metric matches DecisionMSE: summed per-sample mean-sq-error
+        metric = jnp.sum(jnp.mean(jnp.square(diff), axis=1))
+        return grad_loss, metric / n_valid, metric
+
+    def _build(self):
+        if isinstance(self.evaluator, EvaluatorSoftmax):
+            self.loss_kind = "softmax"
+        elif isinstance(self.evaluator, EvaluatorMSE):
+            self.loss_kind = "mse"
+        else:
+            raise TypeError("unsupported evaluator %r" % self.evaluator)
+        solvers = []
+        hypers = []
+        for fwd in self.forwards:
+            gd = self.gd_for.get(id(fwd))
+            solvers.append(get_solver(gd.solver_name) if gd else None)
+            hypers.append(gd.hyper if gd else None)
+        self.solvers = solvers
+        self.hypers = hypers
+
+        # resolve the dataset's device arrays OUTSIDE any trace: calling
+        # .devmem under jit would cache a tracer inside the Array
+        dataset = self.loader.original_data.devmem
+        truth_src = (self.loader.original_labels.devmem
+                     if self.loss_kind == "softmax"
+                     else self.loader.original_targets.devmem)
+
+        def gather(idx):
+            data = jnp.take(dataset, jnp.maximum(idx, 0), axis=0)
+            data = data * (idx >= 0).reshape(
+                (-1,) + (1,) * (data.ndim - 1)).astype(data.dtype)
+            truth = jnp.take(truth_src, jnp.maximum(idx, 0), axis=0)
+            return data, truth
+
+        def train_batch(carry, batch_in):
+            params_list, opt_states = carry
+            idx, key = batch_in
+            x, truth = gather(idx)
+            valid = idx >= 0
+
+            def loss_fn(plist):
+                out = self._forward(plist, x, key, train=True)
+                grad_loss, report, metric = self._loss_and_metrics(
+                    out, truth, valid)
+                return grad_loss, (report, metric)
+
+            (_, (loss, metric)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params_list)
+            new_params, new_states = [], []
+            for i in range(len(params_list)):
+                if self.solvers[i] is None or not params_list[i]:
+                    new_params.append(params_list[i])
+                    new_states.append(opt_states[i])
+                    continue
+                p, s = self.solvers[i].update(
+                    params_list[i], grads[i], opt_states[i],
+                    self.hypers[i])
+                new_params.append(p)
+                new_states.append(s)
+            return (tuple(new_params), tuple(new_states)), (loss, metric)
+
+        def train_segment(params_list, opt_states, idx_matrix, keys):
+            (params_list, opt_states), (losses, metrics) = jax.lax.scan(
+                train_batch, (params_list, opt_states), (idx_matrix, keys))
+            return params_list, opt_states, losses, metrics
+
+        donate = (0, 1) if self.donate else ()
+        self._train_segment = jax.jit(train_segment,
+                                      donate_argnums=donate)
+
+        def eval_segment_pure(params_list, idx_matrix):
+            def body(_, idx):
+                x, truth = gather(idx)
+                valid = idx >= 0
+                out = self._forward(params_list, x, None, train=False)
+                _, report, metric = self._loss_and_metrics(out, truth,
+                                                           valid)
+                return None, (report, metric)
+            _, (losses, metrics) = jax.lax.scan(body, None, idx_matrix)
+            return losses, metrics
+
+        self._eval_segment = jax.jit(eval_segment_pure)
+
+    # -- parameter plumbing ------------------------------------------------
+
+    def pull_params(self):
+        """Unit Arrays -> device pytrees (one-time HBM residency)."""
+        params = tuple(fwd.param_values() for fwd in self.forwards)
+        states = []
+        for i, fwd in enumerate(self.forwards):
+            gd = self.gd_for.get(id(fwd))
+            if gd is not None and params[i]:
+                if gd.opt_state is None:
+                    gd.opt_state = get_solver(gd.solver_name).init(
+                        params[i])
+                states.append(gd.opt_state)
+            else:
+                states.append({})
+        return params, tuple(states)
+
+    def push_params(self, params, states):
+        """Device pytrees -> unit Arrays (after training)."""
+        for fwd, p, s in zip(self.forwards, params, states):
+            for k, arr in fwd.param_arrays().items():
+                arr.assign_devmem(p[k])
+            gd = self.gd_for.get(id(fwd))
+            if gd is not None:
+                gd.opt_state = s
+
+    # -- index plumbing ----------------------------------------------------
+
+    def _segment_indices(self, klass):
+        """(n_batches, mb) int32 index matrix for a class, padded -1."""
+        loader = self.loader
+        ends = loader.class_end_offsets
+        start = ends[klass] - loader.class_lengths[klass]
+        seg = numpy.asarray(
+            loader.shuffled_indices.map_read()[start:ends[klass]],
+            numpy.int32)
+        mb = loader.max_minibatch_size
+        n_batches = (len(seg) + mb - 1) // mb
+        mat = numpy.full((max(n_batches, 1), mb), -1, numpy.int32)
+        flat = mat.reshape(-1)
+        flat[:len(seg)] = seg
+        return mat
+
+    # -- driving -----------------------------------------------------------
+
+    def run_epoch(self, params, states, epoch):
+        """One epoch: eval classes in reference order, then train."""
+        stats = {}
+        for klass in (TEST, VALIDATION):
+            if not self.loader.class_lengths[klass]:
+                continue
+            idx = self._segment_indices(klass)
+            losses, metrics = self._eval_segment(params, jnp.asarray(idx))
+            stats[CLASS_NAMES[klass]] = self._summarize(
+                losses, metrics, klass)
+        if self.loader.class_lengths[TRAIN]:
+            idx = self._segment_indices(TRAIN)
+            base = prng.get(self.loader.rand_name).jax_key()
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                jnp.arange(idx.shape[0]))
+            params, states, losses, metrics = self._train_segment(
+                params, states, jnp.asarray(idx), keys)
+            stats[CLASS_NAMES[TRAIN]] = self._summarize(
+                losses, metrics, TRAIN)
+            self.loader.epoch_number = epoch + 1
+            if self.loader.epoch_number <= self.loader.shuffle_limit:
+                self.loader.shuffle()
+        return params, states, stats
+
+    def _summarize(self, losses, metrics, klass):
+        n = self.loader.class_lengths[klass]
+        metric_sum = float(jnp.sum(metrics))
+        return {"samples": n, "metric": metric_sum,
+                "normalized": metric_sum / max(n, 1),
+                "loss": float(jnp.mean(losses))}
+
+    def train(self, max_epochs=None):
+        """Full training loop with the decision unit's stop criterion."""
+        decision = self.decision
+        max_epochs = max_epochs if max_epochs is not None \
+            else decision.max_epochs
+        params, states = self.pull_params()
+        epoch = self.loader.epoch_number
+        start = time.perf_counter()
+        while True:
+            params, states, stats = self.run_epoch(params, states, epoch)
+            stats["epoch"] = epoch
+            decision.epoch_history.append(stats)
+            key = ("validation" if self.loader.class_lengths[VALIDATION]
+                   else "train")
+            metric = stats[key]["normalized"]
+            if metric < decision.best_metric:
+                decision.best_metric = metric
+                decision.best_epoch = epoch
+                decision.improved <<= True
+            else:
+                decision.improved <<= False
+            self.info("epoch %d: %s", epoch, "  ".join(
+                "%s=%.4f" % (k, v["normalized"])
+                for k, v in stats.items() if isinstance(v, dict)))
+            epoch += 1
+            if max_epochs is not None and epoch >= max_epochs:
+                break
+            # same inequality as DecisionBase._on_epoch_finished, where
+            # epoch_number is the epoch just completed (= epoch - 1 here)
+            if (epoch - 1) - decision.best_epoch > decision.fail_iterations:
+                break
+        elapsed = time.perf_counter() - start
+        decision.complete <<= True
+        self.workflow.stopped <<= True
+        self.push_params(params, states)
+        n_train = self.loader.class_lengths[TRAIN]
+        epochs_done = len(decision.epoch_history)
+        self.info("fused training: %d epochs in %.2fs (%.0f samples/s)",
+                  epochs_done, elapsed,
+                  epochs_done * n_train / max(elapsed, 1e-9))
+        return decision.epoch_history
