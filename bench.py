@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""Benchmark: AlexNet training throughput, samples/sec/chip.
+
+The driver-defined north star (BASELINE.json: "Znicz ImageNet-AlexNet
+samples/sec/chip"). Trains the full AlexNet stack (227x227x3, 1000
+classes, conv+LRN+pool+fc+dropout+softmax) on synthetic ImageNet-shaped
+data with the fused step compiler on one TPU chip and reports
+steady-state training throughput (compile excluded).
+
+vs_baseline: the reference ships no samples/sec table
+(BASELINE.json.published == {}); 500 img/s is the documented
+2015-era single-GPU AlexNet training throughput (cuDNN-class hardware
+the reference's CUDA backend targeted), used as the denominator.
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+import json
+import logging
+import sys
+import time
+
+logging.disable(logging.WARNING)
+
+BASELINE_SAMPLES_PER_SEC = 500.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu import prng
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.alexnet import (ALEXNET_LAYERS,
+                                          AlexNetWorkflow,
+                                          SyntheticImageLoader)
+    from veles_tpu.train import FusedTrainer
+
+    batch = 128
+    n_train = 1024
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    wf = AlexNetWorkflow(
+        DummyLauncher(),
+        loader_factory=lambda w: SyntheticImageLoader(
+            w, n_train=n_train, n_valid=batch, side=227, n_classes=1000,
+            minibatch_size=batch),
+        layers=ALEXNET_LAYERS, max_epochs=1)
+    wf.initialize(device=Device(backend=None))
+
+    trainer = FusedTrainer(wf)
+    params, states = trainer.pull_params()
+    idx = trainer._segment_indices(2)  # TRAIN segment index matrix
+    keys = jax.random.split(jax.random.PRNGKey(0), idx.shape[0])
+    idx = jnp.asarray(idx)
+
+    # warm-up (compile)
+    params, states, losses, _ = trainer._train_segment(params, states,
+                                                       idx, keys)
+    jax.block_until_ready(losses)
+
+    # steady state: time full training epochs
+    epochs = 3
+    start = time.time()
+    for _ in range(epochs):
+        params, states, losses, _ = trainer._train_segment(
+            params, states, idx, keys)
+    jax.block_until_ready(losses)
+    elapsed = time.time() - start
+
+    samples_per_sec = epochs * n_train / elapsed
+    print(json.dumps({
+        "metric": "alexnet_train_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC,
+                             3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

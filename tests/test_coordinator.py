@@ -1,0 +1,87 @@
+"""Coordinator control-plane tests: the reference's in-process master+
+slave trick (tests/test_launcher.py:60-110) without a cluster."""
+
+import threading
+
+import pytest
+
+from veles_tpu.parallel.coordinator import (CoordinatorClient,
+                                            CoordinatorServer)
+
+
+def test_handshake_checksum_mismatch_rejected():
+    server = CoordinatorServer(checksum="abc")
+    try:
+        with pytest.raises(ConnectionError, match="checksum"):
+            CoordinatorClient(server.address, checksum="WRONG").connect()
+    finally:
+        server.stop()
+
+
+def test_job_farming_roundtrip():
+    server = CoordinatorServer(checksum="c")
+    try:
+        server.submit(*[{"x": i} for i in range(10)])
+        client = CoordinatorClient(server.address, checksum="c").connect()
+        done = client.serve_forever(lambda job: job["x"] * 2, max_idle=3)
+        assert done == 10
+        results = server.wait(10, timeout=5)
+        assert sorted(results) == [0, 2, 4, 6, 8, 10, 12, 14, 16, 18]
+    finally:
+        server.stop()
+
+
+def test_two_slaves_share_queue():
+    server = CoordinatorServer(checksum="c")
+    try:
+        server.submit(*list(range(20)))
+        counts = {}
+
+        def run(name):
+            c = CoordinatorClient(server.address, checksum="c").connect()
+            counts[name] = c.serve_forever(lambda j: j + 1, max_idle=5)
+
+        threads = [threading.Thread(target=run, args=("s%d" % i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        results = server.wait(20, timeout=5)
+        assert sorted(results) == list(range(1, 21))
+        assert sum(counts.values()) == 20
+    finally:
+        server.stop()
+
+
+def test_chaos_death_requeues_job():
+    """A slave dying mid-job must not lose the job (elastic requeue)."""
+    from veles_tpu import prng
+    prng.get("chaos").seed(123)
+    server = CoordinatorServer(checksum="c", heartbeat_timeout=0.5)
+    try:
+        server.submit(*list(range(5)))
+        suicidal = CoordinatorClient(server.address, checksum="c",
+                                     death_probability=1.0).connect()
+        with pytest.raises(RuntimeError, match="chaos"):
+            suicidal.serve_forever(lambda j: j, max_idle=3)
+        # healthy slave finishes everything, including the requeued job
+        healthy = CoordinatorClient(server.address, checksum="c").connect()
+        healthy.serve_forever(lambda j: j, max_idle=30)
+        results = server.wait(5, timeout=10)
+        assert sorted(results) == list(range(5))
+    finally:
+        server.stop()
+
+
+def test_slave_registry_and_power():
+    server = CoordinatorServer(checksum="c")
+    try:
+        client = CoordinatorClient(server.address, checksum="c",
+                                   power=123.0).connect()
+        client.heartbeat()
+        slave = list(server.slaves.values())[0]
+        assert slave.power == 123.0
+        assert slave.id == client.id
+    finally:
+        server.stop()

@@ -1,0 +1,72 @@
+"""Model-family smoke/convergence tests (BASELINE configs 2-4)."""
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.models.alexnet import (AlexNetWorkflow,
+                                      SyntheticImageLoader,
+                                      small_alexnet_layers)
+from veles_tpu.models.cifar import CifarWorkflow
+from veles_tpu.models.mnist_ae import KohonenWorkflow, MnistAEWorkflow
+from veles_tpu.train import FusedTrainer
+
+from test_mnist_e2e import synthetic_digits
+
+
+def _seed(s=42):
+    prng.get().seed(s)
+    prng.get("loader").seed(s + 1)
+
+
+def test_cifar_conv_trains_fused():
+    _seed()
+    wf = CifarWorkflow(DummyLauncher(), synthetic_samples=300,
+                       minibatch_size=50, max_epochs=3,
+                       learning_rate=0.02)
+    wf.initialize(device=Device(backend="cpu"))
+    history = FusedTrainer(wf).train()
+    assert history[-1]["validation"]["normalized"] < \
+        history[0]["validation"]["normalized"]
+
+
+def test_small_alexnet_smoke_eager_one_epoch():
+    _seed()
+    wf = AlexNetWorkflow(
+        DummyLauncher(),
+        loader_factory=lambda wf_: SyntheticImageLoader(
+            wf_, n_train=40, n_valid=20, side=32, n_classes=5,
+            minibatch_size=20),
+        layers=small_alexnet_layers(n_classes=5), max_epochs=1)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    assert len(wf.decision.epoch_history) == 1
+
+
+def test_mnist_autoencoder_rmse_improves():
+    _seed()
+    wf = MnistAEWorkflow(DummyLauncher(), provider=synthetic_digits(),
+                         bottleneck=24, minibatch_size=60, max_epochs=4,
+                         learning_rate=0.03)
+    wf.initialize(device=Device(backend="cpu"))
+    history = FusedTrainer(wf).train()
+    assert history[-1]["validation"]["normalized"] < \
+        history[0]["validation"]["normalized"]
+
+
+def test_kohonen_workflow_runs():
+    _seed()
+    from veles_tpu.models.mnist import MnistLoader
+    wf = KohonenWorkflow(
+        DummyLauncher(),
+        loader_factory=lambda wf_: MnistLoader(
+            wf_, provider=synthetic_digits(n_train=120, n_valid=30),
+            minibatch_size=30),
+        sx=4, sy=4, epochs=3)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    assert bool(wf.stopped)
+    w = numpy.asarray(wf.trainer.weights.map_read())
+    assert numpy.isfinite(w).all()
+    assert wf.trainer.time > 0

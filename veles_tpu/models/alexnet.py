@@ -1,0 +1,92 @@
+"""ImageNet AlexNet workflow — BASELINE config 3, the north-star
+benchmark model ("Znicz ImageNet-AlexNet samples/sec/chip").
+
+The classic 5-conv/3-fc AlexNet expressed as StandardWorkflow layer
+descriptors (conv+LRN+maxpool stages, dropout on the fc trunk, softmax
+head), NHWC on the MXU. Data comes from a provider callable (synthetic
+ImageNet-shaped tensors for benchmarking; a real ImageNet loader plugs
+in the same way).
+"""
+
+import numpy
+
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.standard_workflow import StandardWorkflow
+
+ALEXNET_LAYERS = [
+    {"type": "conv_str", "n_kernels": 96, "kx": 11, "ky": 11,
+     "sliding": (4, 4), "padding": 2},
+    {"type": "norm", "n": 5, "alpha": 1e-4, "beta": 0.75},
+    {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+    {"type": "conv_str", "n_kernels": 256, "kx": 5, "ky": 5,
+     "padding": 2},
+    {"type": "norm", "n": 5, "alpha": 1e-4, "beta": 0.75},
+    {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+    {"type": "conv_str", "n_kernels": 384, "kx": 3, "ky": 3,
+     "padding": 1},
+    {"type": "conv_str", "n_kernels": 384, "kx": 3, "ky": 3,
+     "padding": 1},
+    {"type": "conv_str", "n_kernels": 256, "kx": 3, "ky": 3,
+     "padding": 1},
+    {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+    {"type": "all2all_str", "output_sample_shape": 4096},
+    {"type": "dropout", "dropout_ratio": 0.5},
+    {"type": "all2all_str", "output_sample_shape": 4096},
+    {"type": "dropout", "dropout_ratio": 0.5},
+    {"type": "softmax", "output_sample_shape": 1000},
+]
+
+
+def small_alexnet_layers(n_classes=1000):
+    """A proportionally shrunk AlexNet for tests/small chips."""
+    return [
+        {"type": "conv_str", "n_kernels": 16, "kx": 5, "ky": 5,
+         "sliding": (2, 2)},
+        {"type": "max_pooling", "kx": 2, "ky": 2},
+        {"type": "conv_str", "n_kernels": 32, "kx": 3, "ky": 3},
+        {"type": "max_pooling", "kx": 2, "ky": 2},
+        {"type": "all2all_str", "output_sample_shape": 128},
+        {"type": "dropout", "dropout_ratio": 0.5},
+        {"type": "softmax", "output_sample_shape": n_classes},
+    ]
+
+
+class SyntheticImageLoader(FullBatchLoader):
+    """ImageNet-shaped synthetic data (benchmarking / smoke tests)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, n_train=512, n_valid=128, side=227,
+                 channels=3, n_classes=1000, seed=1, **kwargs):
+        kwargs.setdefault("normalization_type", "none")
+        super(SyntheticImageLoader, self).__init__(workflow, **kwargs)
+        self._gen = (n_train, n_valid, side, channels, n_classes, seed)
+
+    def load_dataset(self):
+        n_train, n_valid, side, channels, n_classes, seed = self._gen
+        rng = numpy.random.RandomState(seed)
+        total = n_train + n_valid
+        data = rng.rand(total, side, side, channels).astype(
+            numpy.float32) * 2 - 1
+        labels = rng.randint(0, n_classes, total).astype(numpy.int32)
+        self.original_data.reset(data)
+        self.original_labels.reset(labels)
+        self.class_lengths = [0, n_valid, n_train]
+
+
+class AlexNetWorkflow(StandardWorkflow):
+    """AlexNet over any FullBatch image loader."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, loader_factory=None, layers=None,
+                 **kwargs):
+        kwargs.setdefault("loss", "softmax")
+        kwargs.setdefault("learning_rate", 0.01)
+        kwargs.setdefault("momentum", 0.9)
+        kwargs.setdefault("weights_decay", 5e-4)
+        super(AlexNetWorkflow, self).__init__(
+            workflow,
+            loader=loader_factory or (lambda wf: SyntheticImageLoader(wf)),
+            layers=layers if layers is not None else ALEXNET_LAYERS,
+            **kwargs)

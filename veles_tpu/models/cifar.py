@@ -1,0 +1,102 @@
+"""CIFAR-10 convnet — BASELINE config 2 (reference baseline: 17.21%
+validation error with the Caffe-style config,
+``manualrst_veles_algorithms.rst:50``).
+
+Caffe cifar10-quick-style conv stack over StandardWorkflow, with the
+reference's mean-dispersion input normalization. Reads the standard
+CIFAR-10 python pickles when a directory is given; synthetic fallback
+for tests.
+"""
+
+import os
+import pickle
+
+import numpy
+
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.standard_workflow import StandardWorkflow
+
+CIFAR_LAYERS = [
+    {"type": "conv_str", "n_kernels": 32, "kx": 5, "ky": 5, "padding": 2},
+    {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+    {"type": "conv_str", "n_kernels": 32, "kx": 5, "ky": 5, "padding": 2},
+    {"type": "avg_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+    {"type": "conv_str", "n_kernels": 64, "kx": 5, "ky": 5, "padding": 2},
+    {"type": "avg_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+    {"type": "all2all", "output_sample_shape": 64},
+    {"type": "softmax", "output_sample_shape": 10},
+]
+
+
+class CifarLoader(FullBatchLoader):
+    """CIFAR-10 python-pickle loader (batches 1-5 train, test_batch
+    validation) with mean_disp normalization, or synthetic data."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, directory=None, synthetic_samples=0,
+                 seed=2, **kwargs):
+        kwargs.setdefault("normalization_type", "mean_disp")
+        super(CifarLoader, self).__init__(workflow, **kwargs)
+        self.directory = directory
+        self.synthetic_samples = synthetic_samples
+        self.seed = seed
+
+    def _load_pickles(self):
+        def batch(name):
+            with open(os.path.join(self.directory, name), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            data = d[b"data"].reshape(-1, 3, 32, 32).transpose(
+                0, 2, 3, 1).astype(numpy.float32)
+            return data, numpy.asarray(d[b"labels"], numpy.int32)
+
+        train = [batch("data_batch_%d" % i) for i in range(1, 6)]
+        valid = batch("test_batch")
+        train_x = numpy.concatenate([t[0] for t in train])
+        train_y = numpy.concatenate([t[1] for t in train])
+        return train_x, train_y, valid[0], valid[1]
+
+    def _synthesize(self):
+        rng = numpy.random.RandomState(self.seed)
+        n = self.synthetic_samples or 600
+        nv = max(n // 5, 1)
+        protos = rng.rand(10, 32, 32, 3).astype(numpy.float32)
+
+        def make(count):
+            labels = rng.randint(0, 10, count).astype(numpy.int32)
+            data = protos[labels] + rng.normal(
+                0, 0.25, (count, 32, 32, 3)).astype(numpy.float32)
+            return data, labels
+
+        tx, ty = make(n)
+        vx, vy = make(nv)
+        return tx, ty, vx, vy
+
+    def load_dataset(self):
+        if self.directory and os.path.isdir(self.directory):
+            tx, ty, vx, vy = self._load_pickles()
+        else:
+            tx, ty, vx, vy = self._synthesize()
+        self.original_data.reset(numpy.concatenate([vx, tx]))
+        self.original_labels.reset(numpy.concatenate([vy, ty]))
+        self.class_lengths = [0, len(vx), len(tx)]
+
+
+class CifarWorkflow(StandardWorkflow):
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, directory=None,
+                 synthetic_samples=0, layers=None, **kwargs):
+        kwargs.setdefault("loss", "softmax")
+        kwargs.setdefault("learning_rate", 0.01)
+        kwargs.setdefault("momentum", 0.9)
+        kwargs.setdefault("weights_decay", 4e-3)
+        minibatch_size = kwargs.pop("minibatch_size", 100)
+        super(CifarWorkflow, self).__init__(
+            workflow,
+            loader=lambda wf: CifarLoader(
+                wf, directory=directory,
+                synthetic_samples=synthetic_samples,
+                minibatch_size=minibatch_size),
+            layers=layers if layers is not None else CIFAR_LAYERS,
+            **kwargs)

@@ -1,0 +1,133 @@
+"""MNIST autoencoder + Kohonen SOM workflows — BASELINE config 4
+(reference baseline: AE validation RMSE 0.5478,
+``manualrst_veles_algorithms.rst:69``; these configs exercise the
+matrix_reduce + random kernel paths in the reference).
+"""
+
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.loader.fullbatch import FullBatchLoaderMSE
+from veles_tpu.nn.decision import DecisionMSE
+from veles_tpu.nn.kohonen import KohonenForward, KohonenTrainer
+from veles_tpu.plumbing import Repeater
+from veles_tpu.standard_workflow import StandardWorkflow
+
+
+class AutoencoderLoader(FullBatchLoaderMSE):
+    """MSE loader whose targets ARE the (normalized) inputs."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, provider=None, **kwargs):
+        kwargs.setdefault("normalization_type", "linear")
+        super(AutoencoderLoader, self).__init__(workflow, **kwargs)
+        self.provider = provider
+        self.has_labels = False
+
+    def load_dataset(self):
+        train_x, _, valid_x, _ = self.provider()
+        data = numpy.concatenate([valid_x, train_x]).astype(numpy.float32)
+        self.original_data.reset(data.reshape(len(data), -1))
+        self.class_lengths = [0, len(valid_x), len(train_x)]
+        self.has_labels = False
+
+    def load_data(self):
+        # bypass FullBatchLoaderMSE's targets check: targets are derived
+        # FROM the loaded+normalized data, so load first, then copy
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        FullBatchLoader.load_data(self)
+        if self.original_targets.mem is None:
+            self.original_targets.reset(
+                numpy.array(self.original_data.mem, copy=True))
+
+
+class MnistAEWorkflow(StandardWorkflow):
+    """784 -> bottleneck -> 784 tanh autoencoder under MSE."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, provider=None, bottleneck=100,
+                 **kwargs):
+        kwargs.setdefault("loss", "mse")
+        kwargs.setdefault("learning_rate", 0.05)
+        minibatch_size = kwargs.pop("minibatch_size", 100)
+        layers = kwargs.pop("layers", None) or [
+            {"type": "all2all_tanh", "output_sample_shape": bottleneck},
+            {"type": "all2all", "output_sample_shape": None},
+        ]
+        self._provider = provider
+
+        def loader_factory(wf):
+            return AutoencoderLoader(wf, provider=provider,
+                                     minibatch_size=minibatch_size)
+
+        # output layer size = input features; resolved after load in
+        # initialize — use a placeholder now
+        self._layers_cfg = layers
+        super(MnistAEWorkflow, self).__init__(
+            workflow, loader=loader_factory,
+            layers=self._resolve_layers(layers, provider),
+            mse_target_attr="minibatch_targets", **kwargs)
+
+    @staticmethod
+    def _resolve_layers(layers, provider):
+        resolved = []
+        features = None  # load the dataset at most ONCE, for the shape
+        for descr in layers:
+            descr = dict(descr)
+            if descr.get("output_sample_shape") is None:
+                if features is None:
+                    train_x = provider()[0]
+                    features = int(numpy.prod(train_x.shape[1:]))
+                descr["output_sample_shape"] = features
+            resolved.append(descr)
+        return resolved
+
+
+class KohonenWorkflow(AcceleratedWorkflow):
+    """SOM training loop: repeater -> loader -> trainer (+forward)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, loader_factory=None, sx=8, sy=8,
+                 epochs=10, **kwargs):
+        super(KohonenWorkflow, self).__init__(workflow, **kwargs)
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+        self.loader = loader_factory(self)
+        self.loader.link_from(self.repeater)
+        self.trainer = KohonenTrainer(self, sx=sx, sy=sy)
+        self.trainer.link_from(self.loader)
+        self.trainer.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.forward = KohonenForward(self)
+        self.forward.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.forward.link_attrs(self.trainer, "weights")
+
+        from veles_tpu.mutable import Bool
+        from veles_tpu.units import Unit
+
+        class EpochCounter(Unit):
+            hide_from_registry = True
+
+            def __init__(self, wf, **kw):
+                super(EpochCounter, self).__init__(wf, **kw)
+                self.complete = Bool(False)
+                self.demand("epoch_ended", "epoch_number")
+
+            def initialize(self, **kw):
+                pass
+
+            def run(self):
+                if bool(self.epoch_ended) and \
+                        self.epoch_number >= epochs:
+                    self.complete <<= True
+
+        self.counter = EpochCounter(self, name="counter")
+        self.counter.link_from(self.trainer)
+        self.counter.link_attrs(self.loader, "epoch_ended",
+                                "epoch_number")
+        self.repeater.link_from(self.counter)
+        self.repeater.gate_block = self.counter.complete
+        self.end_point.link_from(self.counter)
+        self.end_point.gate_block = ~self.counter.complete

@@ -9,13 +9,17 @@ from veles_tpu.nn.base import ForwardBase
 
 
 def lrn(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
-    """Cross-channel LRN over NHWC: AlexNet formula."""
+    """Cross-channel LRN over NHWC: AlexNet formula.
+
+    The channel-window sum is n shifted slices (n is tiny, XLA fuses
+    them) — generic-reducer reduce_window has no autodiff rule."""
     sq = jnp.square(x)
     half = n // 2
     padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
-    window = jax.lax.reduce_window(
-        padded, jnp.float32(0), jax.lax.add,
-        (1,) * (x.ndim - 1) + (n,), (1,) * x.ndim, "VALID")
+    channels = x.shape[-1]
+    window = sum(
+        jax.lax.slice_in_dim(padded, i, i + channels, axis=x.ndim - 1)
+        for i in range(n))
     return x / jnp.power(k + alpha * window, beta)
 
 

@@ -48,27 +48,38 @@ class MaxPooling(PoolingBase):
 
 
 class MaxAbsPooling(PoolingBase):
-    """Picks the value with max |value| in each window (Znicz variant)."""
+    """Picks the value with max |value| in each window (Znicz variant).
+
+    Expressed through max/min windows (both autodiff-supported) instead
+    of a custom reducer, which XLA cannot differentiate."""
 
     def apply(self, params, x):
         if x.ndim == 3:
             x = x[..., None]
-
-        def select(a, b):
-            return jnp.where(jnp.abs(a) >= jnp.abs(b), a, b)
-
-        return jax.lax.reduce_window(
-            x, jnp.float32(0), select, self._window(), self._strides(),
+        mx = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, self._window(), self._strides(),
             "VALID")
+        mn = jax.lax.reduce_window(
+            x, jnp.inf, jax.lax.min, self._window(), self._strides(),
+            "VALID")
+        return jnp.where(mx >= -mn, mx, mn)
 
 
 class AvgPooling(PoolingBase):
+    """Sum-window as a depthwise ones-kernel conv: differentiable and
+    MXU-lowerable (generic-reducer reduce_window has no vjp)."""
+
     def apply(self, params, x):
         if x.ndim == 3:
             x = x[..., None]
-        summed = jax.lax.reduce_window(
-            x, jnp.float32(0), jax.lax.add, self._window(), self._strides(),
-            "VALID")
+        channels = x.shape[-1]
+        kernel = jnp.ones((self.ky, self.kx, 1, channels), x.dtype)
+        summed = jax.lax.conv_general_dilated(
+            x, kernel, window_strides=(self.sliding[1], self.sliding[0]),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=channels,
+            preferred_element_type=jnp.float32)
         return summed / float(self.kx * self.ky)
 
 

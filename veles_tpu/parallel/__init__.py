@@ -1,0 +1,27 @@
+"""Distributed execution over TPU meshes.
+
+The reference's only tensor-level parallelism is master↔slave data
+parallelism over ZeroMQ (SURVEY.md §2.4): master holds canonical state,
+slaves compute, updates merge point-to-point. On TPU that entire data
+plane becomes XLA collectives over ICI/DCN under a single controller:
+
+* :mod:`mesh`        — device mesh construction + multi-host init;
+* :mod:`dp`          — data-parallel fused training (batch sharded over
+  the ``data`` axis; XLA inserts the gradient all-reduce — the
+  ``lax.psum`` that replaces the ZeroMQ update merge);
+* :mod:`tp`          — tensor-parallel layer sharding rules;
+* :mod:`pp`          — GPipe-style pipeline over a ``pipe`` axis;
+* :mod:`sequence`    — ring attention / context parallelism over a
+  ``seq`` axis (K/V blocks rotate via ppermute with streaming-softmax
+  accumulation) — first-class here even though the 2015 reference
+  predates attention (SURVEY.md §5 "long-context: absent");
+* :mod:`coordinator` — the surviving *control* plane: master/slave
+  handshake with topology checksum, heartbeats, elastic requeue and
+  chaos injection for task farming (genetics/ensemble) and multi-host
+  bring-up. Data never flows through it.
+"""
+
+from veles_tpu.parallel.mesh import (build_mesh, local_device_count,  # noqa
+                                     named_sharding)
+from veles_tpu.parallel.dp import DataParallelTrainer  # noqa: F401
+from veles_tpu.parallel.sequence import ring_attention  # noqa: F401

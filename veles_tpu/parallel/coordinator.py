@@ -1,0 +1,349 @@
+"""Host-side coordination service: the control plane that survives.
+
+On TPU the *data* plane of the reference's distributed runtime became
+XLA collectives (see :mod:`veles_tpu.parallel.dp`); what remains is the
+*control* plane the reference ran over Twisted TCP JSON lines
+(``veles/server.py``, ``veles/client.py``, ``network_common.py:132``):
+
+* handshake with workflow **checksum** verification (a slave running a
+  different graph is rejected — ``server.py:484-492``);
+* slave registry with per-slave FSM (WAIT→WORK→...), ``computing_power``
+  load metric, heartbeats with timeout-based **death detection**;
+* a generic **job queue** for task farming (genetics chromosomes,
+  ensemble members, dataset shards): jobs held by a dead slave are
+  **requeued** (``loader/base.py:679-687`` semantics);
+* **chaos injection**: ``death_probability`` makes a slave kill itself
+  mid-job (the reference's ``--slave-death-probability``,
+  ``client.py:303-307``) so elasticity is testable in-process.
+
+Implementation is stdlib sockets + threads (no Twisted): JSON lines,
+one reader thread per connection on the master, a single client thread
+on the slave. Job payloads must be JSON-serializable.
+"""
+
+import json
+import socket
+import threading
+import time
+import uuid
+
+from veles_tpu import prng
+from veles_tpu.logger import Logger
+
+
+class Protocol(object):
+    """JSON-lines framing over a socket."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self._file = sock.makefile("rwb")
+        self._wlock = threading.Lock()
+
+    def send(self, message):
+        data = (json.dumps(message) + "\n").encode()
+        with self._wlock:
+            self._file.write(data)
+            self._file.flush()
+
+    def recv(self):
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("peer closed")
+        return json.loads(line)
+
+    def close(self):
+        try:
+            self._file.close()
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class SlaveDescription(object):
+    """Master-side view of one slave (``veles/server.py:494-511``)."""
+
+    def __init__(self, sid, power, mid, pid):
+        self.id = sid
+        self.power = power
+        self.mid = mid
+        self.pid = pid
+        self.state = "WAIT"
+        self.jobs_done = 0
+        self.last_seen = time.time()
+        self.current_job = None
+
+
+class CoordinatorServer(Logger):
+    """Master: accepts slaves, verifies checksum, farms jobs out."""
+
+    def __init__(self, address=("127.0.0.1", 0), checksum="",
+                 job_timeout=None, heartbeat_timeout=10.0):
+        super(CoordinatorServer, self).__init__()
+        self.checksum = checksum
+        self.job_timeout = job_timeout
+        self.heartbeat_timeout = heartbeat_timeout
+        self.slaves = {}
+        self.jobs = []                 # pending job payloads
+        self.results = []
+        self.job_times = []            # history for adaptive timeout
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._listener = socket.create_server(address)
+        self.address = self._listener.getsockname()
+        self._threads = []
+        self._accepting = True
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="coordinator-accept")
+        t.start()
+        self._threads.append(t)
+        # independent reaper: death detection must not depend on the
+        # master happening to sit in wait()
+        r = threading.Thread(target=self._reap_loop, daemon=True,
+                             name="coordinator-reaper")
+        r.start()
+        self._threads.append(r)
+
+    def _reap_loop(self):
+        while not self._done.wait(min(self.heartbeat_timeout / 4, 1.0)):
+            with self._lock:
+                self._reap_dead()
+
+    # -- job management ----------------------------------------------------
+
+    def submit(self, *payloads):
+        with self._lock:
+            self.jobs.extend(payloads)
+
+    def wait(self, n_results, timeout=60.0):
+        """Block until ``n_results`` results arrived (or timeout)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                self._reap_dead()
+                if len(self.results) >= n_results:
+                    return list(self.results)
+            time.sleep(0.05)
+        raise TimeoutError("only %d/%d results" %
+                           (len(self.results), n_results))
+
+    def _adaptive_timeout(self):
+        """max(mean + 3σ of history, job_timeout) — ``server.py:619-629``."""
+        if self.job_timeout is None and len(self.job_times) < 3:
+            return None
+        if self.job_times:
+            import statistics
+            mean = statistics.mean(self.job_times)
+            sd = statistics.pstdev(self.job_times)
+            adaptive = mean + 3 * sd
+            return max(adaptive, self.job_timeout or 0.0)
+        return self.job_timeout
+
+    def _reap_dead(self):
+        """Requeue jobs of slaves that stopped heartbeating/overran."""
+        now = time.time()
+        timeout = self._adaptive_timeout()
+        for sid, slave in list(self.slaves.items()):
+            dead = now - slave.last_seen > self.heartbeat_timeout
+            overrun = (timeout is not None and slave.current_job and
+                       now - slave.current_job[1] > timeout)
+            if dead or overrun:
+                self.warning("dropping slave %s (%s)", sid,
+                             "dead" if dead else "job timeout")
+                self.drop_slave(sid)
+
+    def drop_slave(self, sid):
+        slave = self.slaves.pop(sid, None)
+        if slave is not None and slave.current_job is not None:
+            self.jobs.insert(0, slave.current_job[0])  # requeue first
+            slave.current_job = None
+
+    # -- wire --------------------------------------------------------------
+
+    def _accept_loop(self):
+        while self._accepting:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(sock,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, sock):
+        proto = Protocol(sock)
+        sid = None
+        try:
+            hello = proto.recv()
+            if hello.get("cmd") == "hb_attach":
+                # dedicated heartbeat channel: keeps last_seen fresh even
+                # while the main channel is busy executing a long job
+                self._serve_heartbeats(proto, hello.get("id"))
+                return
+            if hello.get("cmd") != "handshake":
+                proto.send({"error": "expected handshake"})
+                return
+            if hello.get("checksum") != self.checksum:
+                # reject incompatible workflow topology
+                proto.send({"error": "checksum mismatch",
+                            "expected": self.checksum})
+                return
+            sid = str(uuid.uuid4())[:8]
+            with self._lock:
+                self.slaves[sid] = SlaveDescription(
+                    sid, hello.get("power", 1.0), hello.get("mid"),
+                    hello.get("pid"))
+            proto.send({"id": sid, "log_id": sid})
+            while not self._done.is_set():
+                msg = proto.recv()
+                cmd = msg.get("cmd")
+                # compute the reply under the lock, send OUTSIDE it — a
+                # slow-reading peer must not stall the whole control plane
+                with self._lock:
+                    slave = self.slaves.get(sid)
+                    if slave is None:
+                        reply, stop = {"error": "dropped"}, True
+                    else:
+                        slave.last_seen = time.time()
+                        stop = False
+                        if cmd == "job":
+                            if self.jobs:
+                                payload = self.jobs.pop(0)
+                                slave.current_job = (payload, time.time())
+                                slave.state = "WORK"
+                                reply = {"job": payload}
+                            else:
+                                slave.state = "IDLE"
+                                reply = {"job": None}
+                        elif cmd == "result":
+                            if slave.current_job is not None:
+                                self.job_times.append(
+                                    time.time() - slave.current_job[1])
+                            slave.current_job = None
+                            slave.jobs_done += 1
+                            slave.state = "WAIT"
+                            self.results.append(msg.get("data"))
+                            reply = {"ok": True}
+                        elif cmd == "heartbeat":
+                            slave.power = msg.get("power", slave.power)
+                            reply = {"ok": True}
+                        else:
+                            reply = {"error": "unknown cmd %r" % cmd}
+                proto.send(reply)
+                if stop:
+                    return
+        except (ConnectionError, json.JSONDecodeError, OSError):
+            pass
+        finally:
+            if sid is not None:
+                with self._lock:
+                    self.drop_slave(sid)
+            proto.close()
+
+    def _serve_heartbeats(self, proto, sid):
+        proto.send({"ok": sid in self.slaves})
+        while not self._done.is_set():
+            msg = proto.recv()
+            with self._lock:
+                slave = self.slaves.get(sid)
+                if slave is None:
+                    reply, stop = {"error": "dropped"}, True
+                else:
+                    slave.last_seen = time.time()
+                    slave.power = msg.get("power", slave.power)
+                    reply, stop = {"ok": True}, False
+            proto.send(reply)
+            if stop:
+                return
+
+    def stop(self):
+        self._accepting = False
+        self._done.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class CoordinatorClient(Logger):
+    """Slave: pulls jobs, executes a callback, pushes results."""
+
+    def __init__(self, address, checksum="", power=1.0,
+                 death_probability=0.0, rand="chaos",
+                 heartbeat_interval=2.0):
+        super(CoordinatorClient, self).__init__()
+        self.address = tuple(address)
+        self.checksum = checksum
+        self.power = power
+        self.death_probability = death_probability
+        self.heartbeat_interval = heartbeat_interval
+        self._rand = prng.get(rand)
+        self.id = None
+        self.jobs_done = 0
+        self._hb_stop = threading.Event()
+
+    def connect(self):
+        sock = socket.create_connection(self.address, timeout=10.0)
+        self.proto = Protocol(sock)
+        import os
+        self.proto.send({"cmd": "handshake", "checksum": self.checksum,
+                         "power": self.power,
+                         "mid": hex(uuid.getnode()), "pid": os.getpid()})
+        reply = self.proto.recv()
+        if "error" in reply:
+            raise ConnectionError(reply["error"])
+        self.id = reply["id"]
+        # dedicated heartbeat channel so long handler() runs don't get
+        # this slave declared dead mid-job
+        hb_sock = socket.create_connection(self.address, timeout=10.0)
+        self._hb_proto = Protocol(hb_sock)
+        self._hb_proto.send({"cmd": "hb_attach", "id": self.id})
+        self._hb_proto.recv()
+        t = threading.Thread(target=self._hb_loop, daemon=True,
+                             name="slave-heartbeat-%s" % self.id)
+        t.start()
+        return self
+
+    def _hb_loop(self):
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            try:
+                self._hb_proto.send({"cmd": "heartbeat",
+                                     "power": self.power})
+                self._hb_proto.recv()
+            except (ConnectionError, OSError):
+                return
+
+    def serve_forever(self, handler, idle_sleep=0.05, max_idle=None):
+        """Pull/execute/push until the queue stays empty (or forever)."""
+        idle = 0
+        while True:
+            self.proto.send({"cmd": "job"})
+            reply = self.proto.recv()
+            job = reply.get("job")
+            if job is None:
+                idle += 1
+                if max_idle is not None and idle >= max_idle:
+                    return self.jobs_done
+                time.sleep(idle_sleep)
+                continue
+            idle = 0
+            if self.death_probability and \
+                    self._rand.rand() < self.death_probability:
+                # chaos: die mid-job without reporting (--slave-death-
+                # probability parity) — the master must requeue
+                self.proto.close()
+                raise RuntimeError("chaos death")
+            result = handler(job)
+            self.proto.send({"cmd": "result", "data": result})
+            self.proto.recv()
+            self.jobs_done += 1
+
+    def heartbeat(self):
+        self.proto.send({"cmd": "heartbeat", "power": self.power})
+        self.proto.recv()
+
+    def close(self):
+        self._hb_stop.set()
+        self.proto.close()
+        if hasattr(self, "_hb_proto"):
+            self._hb_proto.close()

@@ -1,0 +1,88 @@
+"""Device mesh construction and multi-host initialization.
+
+Axis naming convention (used across the package):
+
+* ``data``  — data parallelism (gradient psum rides ICI),
+* ``model`` — tensor parallelism (activation collectives),
+* ``pipe``  — pipeline stages (ppermute),
+* ``seq``   — sequence/context parallelism (ring attention).
+
+``build_mesh`` lays axes out so the fastest-varying axis maps to
+physically adjacent devices (JAX mesh_utils handles the torus topology
+when available), which keeps ``model``/``seq`` collectives on short ICI
+paths and pushes ``data`` onto the remaining links — the scaling-book
+recipe.
+"""
+
+import jax
+import numpy
+
+
+def local_device_count(platform=None):
+    try:
+        return len(jax.devices(platform) if platform else jax.devices())
+    except RuntimeError:
+        return 0
+
+
+def build_mesh(axes=None, devices=None):
+    """Build a ``jax.sharding.Mesh``.
+
+    ``axes``: ordered dict/list of (name, size); sizes must multiply to
+    the device count, a single -1 size is inferred. Default: pure data
+    parallelism over all visible devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"data": n}
+    if isinstance(axes, dict):
+        axes = list(axes.items())
+    names = [a[0] for a in axes]
+    sizes = [a[1] for a in axes]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one inferred (-1) axis")
+    if -1 in sizes:
+        known = int(numpy.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError("cannot infer axis: %d %% %d" % (n, known))
+        sizes[sizes.index(-1)] = n // known
+    if int(numpy.prod(sizes)) != n:
+        raise ValueError("mesh %r needs %d devices, have %d" %
+                         (dict(zip(names, sizes)),
+                          int(numpy.prod(sizes)), n))
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(tuple(sizes),
+                                                  devices=devices)
+    except Exception:
+        dev_array = numpy.asarray(devices).reshape(sizes)
+    return jax.sharding.Mesh(dev_array, tuple(names))
+
+
+def named_sharding(mesh, *spec):
+    """Shorthand for NamedSharding(mesh, PartitionSpec(*spec))."""
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(*spec))
+
+
+def replicated(mesh):
+    return named_sharding(mesh)
+
+
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None):
+    """Initialize jax.distributed for multi-host pods (DCN).
+
+    The reference's SSH slave spawning (``launcher.py:808-842``) maps to
+    the cluster scheduler starting one process per host; this call wires
+    them into one JAX runtime. No-op when standalone.
+    """
+    if num_processes in (None, 1):
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    return True

@@ -1,0 +1,75 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
+
+Each device along ``pipe`` owns one stage's parameters (stacked on the
+leading axis and sharded). Microbatches stream through: every clock
+tick, activations hop to the next stage via ``lax.ppermute`` while each
+stage applies its layer — the canonical collective-pipeline pattern.
+Total ticks = n_microbatches + n_stages - 1 (bubble included).
+
+The stage function must be shape-preserving (x -> x), the usual
+residual-block contract.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(stage_fn, stacked_params, x_microbatches, mesh,
+                   axis="pipe"):
+    """Run microbatches through a pipeline of stages.
+
+    * ``stage_fn(params, x) -> x`` — one stage's computation;
+    * ``stacked_params`` — pytree whose leaves have leading dim
+      n_stages (sharded over ``axis``);
+    * ``x_microbatches`` — (n_micro, mb, ...) batch, replicated.
+
+    Returns (n_micro, mb, ...) outputs (replicated).
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = x_microbatches.shape[0]
+    total_ticks = n_micro + n_stages - 1
+
+    params_spec = jax.tree_util.tree_map(
+        lambda _: P(axis), stacked_params)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(params_spec, P()), out_specs=P(),
+        check_vma=False)
+    def run(params, xs):
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(axis)
+        state = jnp.zeros_like(xs[0])          # in-flight activation
+        outputs = jnp.zeros_like(xs)
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(t, carry):
+            state, outputs = carry
+            # stage 0 injects microbatch t (if any left)
+            inject = jnp.where(t < n_micro,
+                               xs[jnp.minimum(t, n_micro - 1)],
+                               jnp.zeros_like(state))
+            state = jnp.where(stage == 0, inject, state)
+            state = stage_fn(my_params, state)
+            # last stage emits microbatch t - (n_stages - 1)
+            out_idx = t - (n_stages - 1)
+            emit = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            outputs = jax.lax.cond(
+                emit,
+                lambda o: o.at[jnp.maximum(out_idx, 0)].set(state),
+                lambda o: o,
+                outputs)
+            # rotate activations to the next stage
+            state = jax.lax.ppermute(state, axis, fwd_perm)
+            return state, outputs
+
+        _, outputs = jax.lax.fori_loop(0, total_ticks, tick,
+                                       (state, outputs))
+        # outputs accumulated on the last stage; broadcast to all
+        keep = (stage == n_stages - 1).astype(outputs.dtype)
+        return jax.lax.psum(outputs * keep, axis)
+
+    return run(stacked_params, x_microbatches)

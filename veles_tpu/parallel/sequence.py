@@ -1,0 +1,107 @@
+"""Sequence/context parallelism: ring attention.
+
+Long sequences are sharded over the mesh's ``seq`` axis; each device
+holds a Q/K/V block. K/V blocks rotate around the ring via
+``lax.ppermute`` while each device accumulates its Q block's attention
+with the streaming-softmax (flash) recurrence — max ``m``, denominator
+``l`` and weighted sum carried across hops — so the full sequence is
+never materialized on any chip and compute overlaps the ICI transfer.
+
+This is the veles_tpu long-context primitive (the 2015 reference has no
+attention at all — SURVEY.md §5 records it as absent; here it is a
+first-class capability, designed per the task brief).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _block_attention(q, k, v, q_off, k_off, scale, causal, m, l, acc):
+    """One streaming-softmax update of (m, l, acc) with a new K/V block.
+
+    q: (B, H, Sq, D); k/v: (B, H, Sk, D); offsets are the blocks' global
+    sequence positions (for causal masking).
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_off + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 2)
+        k_pos = k_off + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 3)
+        scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+    blk_max = jnp.max(scores, axis=-1)               # (B,H,Sq)
+    new_m = jnp.maximum(m, blk_max)
+    # guard -inf rows (fully masked block): exp(-inf - -inf) -> use safe m
+    safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf,
+                                   m - safe_m))
+    correction = jnp.where(jnp.isneginf(m), 0.0, correction)
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    new_acc = acc * correction[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return new_m, new_l, new_acc
+
+
+def ring_attention(q, k, v, mesh, axis="seq", causal=False, scale=None):
+    """Attention over a sequence sharded on ``axis`` (dim 2 of BHSD).
+
+    Returns the attention output with the same sharding as ``q``.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    n_shards = mesh.shape[axis]
+    spec = P(None, None, axis, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    def inner(q_blk, k_blk, v_blk):
+        seq_shard = q_blk.shape[2]
+        my_idx = jax.lax.axis_index(axis)
+        q_off = my_idx * seq_shard
+        m = jnp.full(q_blk.shape[:3], -jnp.inf, jnp.float32)
+        l = jnp.zeros(q_blk.shape[:3], jnp.float32)
+        acc = jnp.zeros(q_blk.shape[:3] + (q_blk.shape[3],), jnp.float32)
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+        def hop(h, carry):
+            k_cur, v_cur, m, l, acc = carry
+            src_idx = (my_idx - h) % n_shards
+            k_off = src_idx * seq_shard
+            m, l, acc = _block_attention(q_blk, k_cur, v_cur, q_off,
+                                         k_off, scale, causal, m, l, acc)
+            # rotate K/V to the next device while nothing depends on it
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return k_nxt, v_nxt, m, l, acc
+
+        k_cur, v_cur = k_blk, v_blk
+        carry = (k_cur, v_cur, m, l, acc)
+        carry = jax.lax.fori_loop(0, n_shards, hop, carry)
+        _, _, m, l, acc = carry
+        l = jnp.maximum(l, 1e-30)
+        return (acc / l[..., None]).astype(q_blk.dtype)
+
+    return inner(q, k, v)
+
+
+def local_attention(q, k, v, causal=False, scale=None):
+    """Single-device oracle with identical math (for parity tests)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+        scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,163 @@
+"""StandardWorkflow: declarative model construction.
+
+The Znicz ``StandardWorkflow`` builds the canonical training topology
+from a ``layers`` config list (the reference MNIST/CIFAR/AlexNet sample
+configs are exactly such lists). Re-provided here: each descriptor is
+``{"type": <name>, ...params}``; the builder wires
+
+    repeater -> loader -> forwards... -> evaluator -> decision
+    decision -> gd[k] ... gd[0] -> repeater   (gd gated off non-TRAIN)
+    end_point <- decision (gate: decision.complete)
+
+and pairs every parameterized forward with its vjp-based GD unit. The
+result runs eagerly (unit graph) or fused (veles_tpu.train), identically.
+"""
+
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.nn.activation import ActivationUnit
+from veles_tpu.nn.all2all import (All2All, All2AllRELU, All2AllSigmoid,
+                                  All2AllSoftmax, All2AllStrictRELU,
+                                  All2AllTanh)
+from veles_tpu.nn.conv import (Conv, ConvRELU, ConvSigmoid,
+                               ConvStrictRELU, ConvTanh, Deconv)
+from veles_tpu.nn.decision import DecisionGD, DecisionMSE
+from veles_tpu.nn.dropout import DropoutBackward, DropoutForward
+from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
+from veles_tpu.nn.gd import GradientDescentBase
+from veles_tpu.nn.normalization import LRNormalizerForward
+from veles_tpu.nn.pooling import (AvgPooling, Depooling, MaxAbsPooling,
+                                  MaxPooling)
+from veles_tpu.plumbing import Repeater
+
+#: layer descriptor type -> forward unit class (Znicz MAPPING names)
+LAYER_TYPES = {
+    "all2all": All2All,
+    "all2all_tanh": All2AllTanh,
+    "all2all_relu": All2AllRELU,
+    "all2all_str": All2AllStrictRELU,
+    "all2all_sigmoid": All2AllSigmoid,
+    "softmax": All2AllSoftmax,
+    "conv": Conv,
+    "conv_tanh": ConvTanh,
+    "conv_relu": ConvRELU,
+    "conv_str": ConvStrictRELU,
+    "conv_sigmoid": ConvSigmoid,
+    "deconv": Deconv,
+    "max_pooling": MaxPooling,
+    "maxabs_pooling": MaxAbsPooling,
+    "avg_pooling": AvgPooling,
+    "depooling": Depooling,
+    "norm": LRNormalizerForward,
+    "dropout": DropoutForward,
+    "activation": ActivationUnit,
+}
+
+
+class StandardWorkflow(AcceleratedWorkflow):
+    """Canonical training workflow from a loader + layers config."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, loader=None, layers=(),
+                 loss="softmax", learning_rate=0.01, weights_decay=0.0,
+                 momentum=0.0, solver="sgd", max_epochs=None,
+                 fail_iterations=100, mse_target_attr="minibatch_data",
+                 **kwargs):
+        super(StandardWorkflow, self).__init__(workflow, **kwargs)
+        if loader is None:
+            raise ValueError("StandardWorkflow needs a loader factory")
+
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+
+        self.loader = loader(self) if callable(loader) else loader
+        self.loader.link_from(self.repeater)
+
+        # -- forward chain -------------------------------------------------
+        self.forwards = []
+        prev, prev_attr = self.loader, "minibatch_data"
+        for i, descr in enumerate(layers):
+            descr = dict(descr)
+            ltype = descr.pop("type")
+            cls = LAYER_TYPES.get(ltype)
+            if cls is None:
+                raise ValueError("unknown layer type %r (have %s)" %
+                                 (ltype, sorted(LAYER_TYPES)))
+            lr = descr.pop("learning_rate", learning_rate)
+            wd = descr.pop("weights_decay", weights_decay)
+            mom = descr.pop("momentum", momentum)
+            descr.setdefault("name", "%s%d" % (ltype, i))
+            fwd = cls(self, **descr)
+            fwd._gd_hyper = dict(learning_rate=lr, weights_decay=wd,
+                                 momentum=mom)
+            fwd.link_from(prev)
+            fwd.link_attrs(prev, ("input", prev_attr))
+            self.forwards.append(fwd)
+            prev, prev_attr = fwd, "output"
+
+        # -- evaluator + decision ------------------------------------------
+        head = self.forwards[-1]
+        if loss == "softmax":
+            self.evaluator = EvaluatorSoftmax(self, name="evaluator")
+            self.evaluator.link_attrs(self.loader,
+                                      ("labels", "minibatch_labels"))
+            self.decision = DecisionGD(self, max_epochs=max_epochs,
+                                       fail_iterations=fail_iterations,
+                                       name="decision")
+            self.decision.link_attrs(self.evaluator,
+                                     ("minibatch_n_err", "n_err"))
+        elif loss == "mse":
+            self.evaluator = EvaluatorMSE(self, name="evaluator")
+            self.evaluator.link_attrs(self.loader,
+                                      ("target", mse_target_attr))
+            self.evaluator.link_attrs(self.loader,
+                                      ("indices", "minibatch_indices"))
+            self.decision = DecisionMSE(self, max_epochs=max_epochs,
+                                        fail_iterations=fail_iterations,
+                                        name="decision")
+            self.decision.link_attrs(self.evaluator,
+                                     ("minibatch_mse", "mse_per_sample"))
+        else:
+            raise ValueError("loss must be softmax or mse")
+        self.evaluator.link_from(head)
+        self.evaluator.link_attrs(head, "output")
+        self.decision.link_from(self.evaluator)
+        self.decision.link_attrs(self.loader, "minibatch_class",
+                                 "last_minibatch", "epoch_ended",
+                                 "epoch_number", "class_lengths",
+                                 "minibatch_size")
+
+        # -- backward chain ------------------------------------------------
+        self.gds = []
+        err_src, err_attr = self.evaluator, "err_output"
+        for fwd in reversed(self.forwards):
+            gd_cls = (DropoutBackward if isinstance(fwd, DropoutForward)
+                      else GradientDescentBase)
+            hyper = getattr(fwd, "_gd_hyper", {})
+            gd = gd_cls(self, forward=fwd,
+                        learning_rate=hyper.get("learning_rate",
+                                                learning_rate),
+                        weights_decay=hyper.get("weights_decay",
+                                                weights_decay),
+                        momentum=hyper.get("momentum", momentum),
+                        solver=solver,
+                        need_err_input=fwd is not self.forwards[0],
+                        name="gd_" + fwd.name)
+            gd.link_from(self.gds[-1] if self.gds else self.decision)
+            gd.link_attrs(err_src, ("err_output", err_attr))
+            gd.gate_skip = self.decision.gd_skip
+            self.gds.append(gd)
+            err_src, err_attr = gd, "err_input"
+
+        self.repeater.link_from(self.gds[-1] if self.gds
+                                else self.decision)
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+    def set_testing(self, testing=True):
+        """Inference mode: dropout off, no err_output generation."""
+        self.evaluator.testing = testing
+        for fwd in self.forwards:
+            if isinstance(fwd, DropoutForward):
+                fwd.testing = testing

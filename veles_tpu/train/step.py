@@ -168,9 +168,7 @@ class FusedTrainer(Logger):
                 train_batch, (params_list, opt_states), (idx_matrix, keys))
             return params_list, opt_states, losses, metrics
 
-        donate = (0, 1) if self.donate else ()
-        self._train_segment = jax.jit(train_segment,
-                                      donate_argnums=donate)
+        self._train_segment = self._compile_train(train_segment)
 
         def eval_segment_pure(params_list, idx_matrix):
             def body(_, idx):
@@ -183,7 +181,15 @@ class FusedTrainer(Logger):
             _, (losses, metrics) = jax.lax.scan(body, None, idx_matrix)
             return losses, metrics
 
-        self._eval_segment = jax.jit(eval_segment_pure)
+        self._eval_segment = self._compile_eval(eval_segment_pure)
+
+    # -- compilation hooks (overridden by parallel trainers) ---------------
+
+    def _compile_train(self, fn):
+        return jax.jit(fn, donate_argnums=(0, 1) if self.donate else ())
+
+    def _compile_eval(self, fn):
+        return jax.jit(fn)
 
     # -- parameter plumbing ------------------------------------------------
 
