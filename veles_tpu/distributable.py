@@ -49,6 +49,8 @@ class Pickleable(Logger):
     def __setstate__(self, state):
         super(Pickleable, self).__setstate__(state)
         self.init_unpickled()
+        from veles_tpu.mutable import ensure_descriptors
+        ensure_descriptors(self)  # cross-process snapshot restore
 
     @property
     def stripped_pickle(self):

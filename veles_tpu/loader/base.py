@@ -145,9 +145,15 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
             self.minibatch_labels.reset(
                 numpy.zeros(self.max_minibatch_size, numpy.int32))
         self.create_minibatch_data()
-        self._global_offset = 0
-        self.epoch_ended <<= False
-        self.last_minibatch <<= False
+        if getattr(self, "_restored_from_snapshot_", False):
+            # resuming: keep the epoch position and flags that came out
+            # of the snapshot — the epoch continues exactly where the
+            # checkpoint was taken (``veles/snapshotter.py`` contract)
+            self._restored_from_snapshot_ = False
+        else:
+            self._global_offset = 0
+            self.epoch_ended <<= False
+            self.last_minibatch <<= False
 
     def run(self):
         self.serve_next_minibatch()

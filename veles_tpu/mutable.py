@@ -178,29 +178,51 @@ def link(dst, dst_name, src, src_name=None, two_way=False):
     """
     if src_name is None:
         src_name = dst_name
-    cls = type(dst)
-    descr = None
-    default = LinkableAttribute._MISSING
-    for klass in cls.__mro__:
-        candidate = klass.__dict__.get(dst_name)
-        if candidate is None:
-            continue
-        if isinstance(candidate, LinkableAttribute):
-            descr = candidate
-            break
-        if hasattr(candidate, "__get__"):
-            # properties / other descriptors cannot be transparently
-            # shadowed for every other instance of the class
-            raise AttributeError(
-                "cannot link over descriptor %r of %s" % (dst_name, cls))
-        default = candidate  # plain class default: keep it as fallback
-        break
-    if descr is None:
-        descr = LinkableAttribute(dst_name, default)
-        setattr(cls, dst_name, descr)
+    descr = _install_descriptor(type(dst), dst_name)
     links = dst.__dict__.setdefault("__linked__", {})
     links[dst_name] = (src, src_name, two_way)
     return descr
+
+
+def _resolve_link_slot(cls, name):
+    """Walk the MRO for ``name``: returns the installed
+    :class:`LinkableAttribute` if any, else ``(None, default)`` where
+    ``default`` is a plain class attribute to preserve as fallback.
+
+    Raises if ``name`` is claimed by another descriptor (property etc.) —
+    those cannot be transparently shadowed for other instances.
+    """
+    for klass in cls.__mro__:
+        candidate = klass.__dict__.get(name)
+        if candidate is None:
+            continue
+        if isinstance(candidate, LinkableAttribute):
+            return candidate, LinkableAttribute._MISSING
+        if hasattr(candidate, "__get__"):
+            raise AttributeError(
+                "cannot link over descriptor %r of %s" % (name, cls))
+        return None, candidate
+    return None, LinkableAttribute._MISSING
+
+
+def _install_descriptor(cls, name):
+    descr, default = _resolve_link_slot(cls, name)
+    if descr is None:
+        descr = LinkableAttribute(name, default)
+        setattr(cls, name, descr)
+    return descr
+
+
+def ensure_descriptors(obj):
+    """Re-install :class:`LinkableAttribute` descriptors for every link
+    recorded on ``obj``.
+
+    Needed after unpickling in a fresh process: links live in the
+    instance (``__linked__``) but resolution needs the class-level
+    descriptor that :func:`link` installed in the snapshotting process.
+    """
+    for name in obj.__dict__.get("__linked__", {}):
+        _install_descriptor(type(obj), name)
 
 
 def unlink(dst, dst_name, keep_value=True):

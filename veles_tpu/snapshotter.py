@@ -1,0 +1,235 @@
+"""Snapshotter: whole-workflow checkpoint / resume.
+
+Re-designs ``veles/snapshotter.py`` (SnapshotterBase :84, gating
+:159-174, export :387-409, import_ :236-246) around the same design
+choice the reference made: a checkpoint is the **entire workflow
+object** — topology, weights, optimizer state, loader position, epoch
+counters — plus the named PRNG registry, so a resumed run continues
+*mid-epoch* with the identical random stream. The ``*_``-transient
+attribute convention (:class:`veles_tpu.distributable.Pickleable`)
+defines what is dropped and rebuilt; :class:`veles_tpu.memory.Array`
+``map_read()``-s device memory in ``__getstate__`` so HBM-resident
+weights land in the file.
+
+Differences from the reference, deliberate on TPU:
+
+* device buffers are never pickled — the restored workflow re-attaches
+  to whatever device ``initialize(device=...)`` receives (a snapshot
+  taken on TPU restores onto CPU and vice versa);
+* no ODBC target — file targets with gz/bz2/xz compression and a
+  ``_current`` symlink cover the reference's file path; a snapshot is a
+  single self-describing pickle stream with a small header dict.
+"""
+
+import bz2
+import gzip
+import lzma
+import os
+import pickle
+import tempfile
+import time
+
+from veles_tpu import prng
+from veles_tpu.config import root
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+#: extension -> opener; "" means raw
+CODECS = {
+    "": open,
+    "gz": gzip.open,
+    "bz2": bz2.open,
+    "xz": lzma.open,
+}
+
+
+#: magic bytes -> opener (robust against misleading file names)
+MAGIC = ((b"\x1f\x8b", gzip.open), (b"BZh", bz2.open),
+         (b"\xfd7zXZ\x00", lzma.open))
+
+
+def _open_for_read(path):
+    """Open a snapshot for reading, sniffing the compression codec from
+    the file's magic bytes (extension-independent, so symlinks or renamed
+    files always load)."""
+    with open(path, "rb") as probe:
+        head = probe.read(8)
+    for magic, opener in MAGIC:
+        if head.startswith(magic):
+            return opener(path, "rb")
+    return open(path, "rb")
+
+
+class SnapshotterBase(Unit):
+    """Gating + lifecycle; subclasses implement :meth:`export`.
+
+    Gates (``veles/snapshotter.py:159-174``): a snapshot is taken every
+    ``interval`` runs, but not more often than every ``time_interval``
+    seconds, never on slaves, and not at all when
+    ``root.common.disable.snapshotting`` is set.
+    """
+
+    hide_from_registry = True
+    view_group = "SERVICE"
+
+    def __init__(self, workflow, **kwargs):
+        self.prefix = kwargs.pop("prefix", "wf")
+        self.interval = kwargs.pop("interval", 1)
+        self.time_interval = kwargs.pop("time_interval", 15.0)
+        self.compression = kwargs.pop("compression", "gz")
+        super(SnapshotterBase, self).__init__(workflow, **kwargs)
+        self.suffix = ""
+        self.destination = None
+        self.time = 0.0
+        self._skipped_counter = 0
+        self.skip = Bool(False)
+
+    def initialize(self, **kwargs):
+        self.time = time.time()
+
+    def run(self):
+        if self.is_slave or root.common.disable.get("snapshotting", False):
+            return
+        if bool(self.skip):
+            return
+        self._skipped_counter += 1
+        if self._skipped_counter < self.interval:
+            return
+        if time.time() - self.time < self.time_interval:
+            return
+        self._skipped_counter = 0
+        self.export()
+        self.time = time.time()
+
+    def export(self):
+        raise NotImplementedError
+
+
+class SnapshotterToFile(SnapshotterBase):
+    """Pickle the owning workflow (+PRNG registry) to a file.
+
+    File name: ``<directory>/<prefix>_<suffix>.<epoch>.pickle[.gz]``;
+    a ``<prefix>_current.pickle`` symlink always points at the newest
+    snapshot (``veles/snapshotter.py:387-409``).
+    """
+
+    WRITE_ATTEMPTS = 2
+
+    def __init__(self, workflow, **kwargs):
+        self.directory = kwargs.pop(
+            "directory", root.common.dirs.get("snapshots", "."))
+        super(SnapshotterToFile, self).__init__(workflow, **kwargs)
+
+    def export(self):
+        wf = self.workflow
+        suffix = ("_" + self.suffix) if self.suffix else ""
+        ext = ("." + self.compression) if self.compression else ""
+        name = "%s%s.%d.pickle%s" % (self.prefix, suffix,
+                                     self._wf_epoch(wf), ext)
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory, name)
+        payload = dump_workflow(wf)
+        # write to a temp file then rename: a crash mid-write must not
+        # destroy the previous snapshot of the same name
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        os.close(fd)
+        try:
+            with CODECS.get(self.compression, open)(tmp, "wb") as fout:
+                fout.write(payload)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        self.destination = path
+        self._update_symlink(path, ext)
+        self.info("snapshotted to %s (%.1f MiB)", path,
+                  len(payload) / 1048576.0)
+
+    @staticmethod
+    def _wf_epoch(wf):
+        decision = getattr(wf, "decision", None)
+        if decision is not None:
+            return int(getattr(decision, "epoch_number", 0) or 0)
+        loader = getattr(wf, "loader", None)
+        if loader is not None:
+            return int(getattr(loader, "epoch_number", 0) or 0)
+        return 0
+
+    def _update_symlink(self, path, ext=""):
+        link_path = os.path.join(self.directory,
+                                 "%s_current.pickle%s" % (self.prefix, ext))
+        try:
+            if os.path.islink(link_path) or os.path.exists(link_path):
+                os.unlink(link_path)
+            os.symlink(os.path.basename(path), link_path)
+        except OSError as exc:  # filesystems without symlinks
+            self.debug("could not update %s: %s", link_path, exc)
+
+    @staticmethod
+    def import_(path):
+        """Load a snapshot: returns the workflow, with the PRNG registry
+        restored so the random streams continue where they left off."""
+        return load_workflow(path)
+
+
+def dump_workflow(workflow):
+    """Serialize a workflow to bytes (header + graph + PRNG registry)."""
+    launcher = workflow._workflow
+    workflow._workflow = None  # the launcher is never part of a snapshot
+    try:
+        blob = {
+            "format": 1,
+            "checksum": workflow.checksum,
+            "random": dict(prng._generators),
+            "workflow": workflow,
+        }
+        return pickle.dumps(blob, protocol=pickle.HIGHEST_PROTOCOL)
+    finally:
+        workflow._workflow = launcher
+
+
+def load_workflow(path_or_bytes):
+    """Inverse of :func:`dump_workflow`; accepts a path or raw bytes."""
+    if isinstance(path_or_bytes, bytes):
+        blob = pickle.loads(path_or_bytes)
+    else:
+        with _open_for_read(path_or_bytes) as fin:
+            blob = pickle.loads(fin.read())
+    for key, gen in blob.get("random", {}).items():
+        prng._generators[key] = gen
+    workflow = blob["workflow"]
+    workflow._restored_from_snapshot_ = True
+    for unit in workflow:
+        unit._restored_from_snapshot_ = True
+        if hasattr(unit, "__iter__") and unit is not workflow:
+            for sub in unit:  # nested workflows
+                sub._restored_from_snapshot_ = True
+    if workflow.checksum != blob["checksum"]:
+        workflow.warning("restored workflow checksum differs from the "
+                         "one recorded at snapshot time")
+    return workflow
+
+
+def unit_sizes(workflow):
+    """Per-unit pickled sizes — the reference's size diagnostics
+    (``veles/snapshotter.py`` "took too much space" reporting).
+
+    All units are put in stripped mode for the whole measurement:
+    cross-unit references (``forward``, attribute links) then pickle as
+    near-empty stubs, so each number reflects that unit's own payload.
+    """
+    sizes = {}
+    units = list(workflow)
+    for unit in units:
+        unit.stripped_pickle = True
+    try:
+        for unit in units:
+            try:
+                sizes[unit.name] = len(pickle.dumps(
+                    unit, protocol=pickle.HIGHEST_PROTOCOL))
+            except Exception:
+                sizes[unit.name] = -1
+    finally:
+        for unit in units:
+            unit.stripped_pickle = False
+    return sizes

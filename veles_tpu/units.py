@@ -213,8 +213,11 @@ class Unit(Distributable, metaclass=UnitRegistry):
     def _run_wrapped(self):
         if not self._is_initialized:
             raise RuntimeError("unit %s run before initialize" % self.name)
-        if self.stopped and root.common.exceptions.get("run_after_stop",
-                                                       True):
+        if self.stopped and not getattr(self._workflow, "is_running", False) \
+                and root.common.exceptions.get("run_after_stop", True):
+            # running outside the workflow's drain is a bug; running
+            # *during* the final drain is the normal end of a loop
+            # iteration (see Workflow._drain)
             raise RuntimeError("unit %s run after workflow stop" % self.name)
         self.event("run", "begin")
         start = time.perf_counter()
@@ -269,6 +272,9 @@ class Unit(Distributable, metaclass=UnitRegistry):
             state["links_from"] = {}
             state["links_to"] = []
             state["_workflow"] = None
+            # attribute links point at other units: without this a
+            # "stripped" unit still drags the whole graph along
+            state["__linked__"] = {}
         return state
 
 

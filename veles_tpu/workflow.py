@@ -43,6 +43,7 @@ class Workflow(Container):
     def init_unpickled(self):
         super(Workflow, self).init_unpickled()
         self._signals_ = collections.deque()
+        self._aborted_ = False
         self.on_finished_callbacks_ = []
 
     # Workflow.stopped shadows Unit.stopped (which proxies to the parent).
@@ -138,6 +139,7 @@ class Workflow(Container):
         """Run the graph to completion (until the end point fires)."""
         self.event("run", "begin")
         self.stopped <<= False
+        self._aborted_ = False
         self.is_running = True
         start = time.perf_counter()
         try:
@@ -153,11 +155,19 @@ class Workflow(Container):
             self.event("run", "end")
 
     def _drain(self):
+        # Signals already in flight when the end point fires still run:
+        # a loop iteration completes atomically (gates block *new*
+        # iterations via Repeater.gate_block). This is what makes a
+        # snapshot taken at the stop boundary bit-identical to the same
+        # point of an uninterrupted run. An explicit stop() (abort) is
+        # different: it discards everything in flight immediately.
         signals = self._signals_
         while signals:
             dst, src = signals.popleft()
-            if bool(self.stopped):
-                continue  # the end point already ran; drain the rest
+            if self._aborted_:
+                continue
+            if bool(self.stopped) and isinstance(dst, EndPoint):
+                continue  # the end point already ran once
             if bool(dst.gate_block):
                 continue
             if not dst.open_gate(src):
@@ -177,6 +187,8 @@ class Workflow(Container):
             callback()
 
     def stop(self):
+        """Abort: halt the loop now, discarding in-flight signals."""
+        self._aborted_ = True
         self.on_workflow_finished()
 
     def add_finished_callback(self, callback):
