@@ -1,0 +1,214 @@
+"""Launcher tests: in-process master + slave (the reference's trick of
+running both endpoints of the distributed protocol in one process,
+``tests/test_launcher.py:60-110``), plus the CLI entry point."""
+
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from test_mnist_e2e import synthetic_digits
+
+from veles_tpu import prng
+from veles_tpu.launcher import Launcher, parse_address
+from veles_tpu.models.mnist import MnistWorkflow
+
+
+def test_parse_address():
+    assert parse_address("host:123") == ("host", 123)
+    assert parse_address(":123") == ("0.0.0.0", 123)
+    assert parse_address("123") == ("0.0.0.0", 123)
+    assert parse_address(("h", 5)) == ("h", 5)
+
+
+def test_mode_selection():
+    assert Launcher().mode == "standalone"
+    assert Launcher(listen_address="127.0.0.1:0").mode == "master"
+    assert Launcher(master_address="127.0.0.1:1").mode == "slave"
+    with pytest.raises(ValueError):
+        Launcher(listen_address="a:1", master_address="b:2")
+    with pytest.raises(TypeError):
+        Launcher(bogus=True)
+
+
+def _make_workflow(launcher, max_epochs=2):
+    return MnistWorkflow(launcher, provider=synthetic_digits(),
+                         layers=(32,), minibatch_size=60,
+                         learning_rate=0.08, max_epochs=max_epochs)
+
+
+def test_standalone_launcher_runs():
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    launcher = Launcher(graphics=False)
+    wf = _make_workflow(launcher, max_epochs=1)
+    launcher.initialize()
+    launcher.run()
+    assert launcher.stopped
+    assert len(wf.decision.epoch_history) == 1
+
+
+def test_master_slave_training():
+    """Full distributed DP run: master farms minibatches, slave computes,
+    master merges weight deltas and decides the stop."""
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    master = Launcher(listen_address="127.0.0.1:0", graphics=False)
+    wf_master = _make_workflow(master, max_epochs=2)
+    master.initialize()
+    port = master._server.address[1]
+
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    slave = Launcher(master_address="127.0.0.1:%d" % port, graphics=False)
+    wf_slave = _make_workflow(slave, max_epochs=2)
+    slave.initialize()
+
+    slave_thread = threading.Thread(target=slave.run, daemon=True)
+    slave_thread.start()
+    master.run()
+    slave_thread.join(timeout=60)
+    assert not slave_thread.is_alive()
+
+    history = wf_master.decision.epoch_history
+    assert len(history) == 2, history
+    # training made progress and master weights moved off the init
+    assert history[-1]["validation"]["normalized"] < 0.6
+    assert wf_master.gather_results()["best_n_err_pt"] < 0.6
+    assert wf_slave is not None
+
+
+def test_slave_death_requeues_minibatch():
+    """A slave dying mid-epoch must not lose its minibatch: the loader
+    re-serves it and the master still closes every epoch exactly once."""
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    prng.get("chaos").seed(7)
+    master = Launcher(listen_address="127.0.0.1:0", graphics=False,
+                      heartbeat_timeout=1.0)
+    wf_master = _make_workflow(master, max_epochs=2)
+    master.initialize()
+    port = master._server.address[1]
+
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    suicidal = Launcher(master_address="127.0.0.1:%d" % port,
+                        graphics=False, slave_death_probability=1.0)
+    _make_workflow(suicidal, max_epochs=2)
+    suicidal.initialize()
+    with pytest.raises(RuntimeError, match="chaos"):
+        suicidal._run_slave()
+
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    healthy = Launcher(master_address="127.0.0.1:%d" % port,
+                       graphics=False)
+    _make_workflow(healthy, max_epochs=2)
+    healthy.initialize()
+    slave_thread = threading.Thread(target=healthy.run, daemon=True)
+    slave_thread.start()
+    master.run()
+    slave_thread.join(timeout=60)
+    assert not slave_thread.is_alive()
+    history = wf_master.decision.epoch_history
+    assert [h["epoch"] for h in history] == [0, 1], history
+
+
+def test_master_rejects_checksum_mismatch():
+    prng.get().seed(1)
+    prng.get("loader").seed(2)
+    master = Launcher(listen_address="127.0.0.1:0", graphics=False)
+    _make_workflow(master)
+    master.initialize()
+    port = master._server.address[1]
+    slave = Launcher(master_address="127.0.0.1:%d" % port, graphics=False)
+    # different topology → different checksum
+    MnistWorkflow(slave, provider=synthetic_digits(), layers=(16, 16),
+                  minibatch_size=60, max_epochs=2)
+    with pytest.raises(ConnectionError, match="checksum"):
+        slave.initialize()
+    master.stop()
+
+
+WORKFLOW_FILE = """
+import numpy
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.mnist import MnistWorkflow
+
+
+class TinyProvider(object):
+    def __call__(self):
+        rng = numpy.random.RandomState(0)
+        x = rng.rand(80, 6, 6).astype(numpy.float32)
+        y = (x.reshape(80, -1).sum(1) > 18).astype(numpy.int32)
+        return x[:60], y[:60], x[60:], y[60:]
+
+
+def run(load, main):
+    load(MnistWorkflow, provider=TinyProvider(), layers=(8,),
+         minibatch_size=20, max_epochs=2)
+    main()
+"""
+
+
+@pytest.fixture
+def workflow_file(tmp_path):
+    path = tmp_path / "tiny_workflow.py"
+    path.write_text(WORKFLOW_FILE)
+    return str(path)
+
+
+def test_cli_end_to_end(workflow_file, tmp_path):
+    from veles_tpu.__main__ import main
+    result_file = str(tmp_path / "results.json")
+    graph_file = str(tmp_path / "graph.dot")
+    code = main([workflow_file, "-s", "7",
+                 "--result-file", result_file,
+                 "--workflow-graph", graph_file])
+    assert code == 0
+    results = json.load(open(result_file))
+    assert "best_n_err_pt" in results
+    assert "digraph" in open(graph_file).read()
+
+
+def test_cli_config_override(workflow_file, tmp_path):
+    from veles_tpu.__main__ import main
+    from veles_tpu.config import root
+    config_file = tmp_path / "tiny_config.py"
+    config_file.write_text("root.testsection.alpha = 1\n")
+    code = main([workflow_file, str(config_file),
+                 "root.testsection.alpha=42", "-s", "7",
+                 "--dry-run", "exec"])
+    assert code == 0
+    assert root.testsection.alpha == 42
+
+
+def test_cli_dry_run_init(workflow_file):
+    from veles_tpu.__main__ import main
+    assert main([workflow_file, "-s", "7", "--dry-run", "init"]) == 0
+
+
+def test_cli_snapshot_resume(workflow_file, tmp_path):
+    """-w snapshot resumes a finished run without retraining."""
+    from veles_tpu.__main__ import Main
+    from veles_tpu.snapshotter import dump_workflow
+
+    m = Main()
+    assert m.run([workflow_file, "-s", "7"]) == 0
+    snap = str(tmp_path / "wf.snap.pickle")
+    with open(snap, "wb") as f:
+        f.write(dump_workflow(m.workflow))
+
+    m2 = Main()
+    assert m2.run([workflow_file, "-s", "7", "-w", snap,
+                   "--dry-run", "init"]) == 0
+    assert len(m2.workflow.decision.epoch_history) == 2
+
+
+def test_cli_version(capsys):
+    from veles_tpu.__main__ import main
+    assert main(["--version"]) == 0
+    from veles_tpu import __version__
+    assert __version__ in capsys.readouterr().out

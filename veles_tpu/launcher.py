@@ -1,0 +1,331 @@
+"""Launcher: run-mode selection and service lifecycle.
+
+The reference's ``Launcher`` (``veles/launcher.py:100``) owns the Twisted
+reactor, picks standalone/master/slave mode from ``-l``/``-m`` flags,
+spawns the graphics server, posts periodic status to the web dashboard
+and manages slave processes. The TPU build has no reactor — a
+single-controller JAX driver replaces the event loop — so the Launcher
+here is a plain object that:
+
+* selects the mode (``listen_address`` → master, ``master_address`` →
+  slave, neither → standalone);
+* owns the :class:`~veles_tpu.backends.Device` (masters do no compute,
+  ``docs/source/manualrst_veles_distributed_training.rst:14``);
+* wires the workflow's IDistributable protocol onto the
+  :mod:`~veles_tpu.parallel.coordinator` control plane (jobs/updates are
+  pickled and base64-framed — the ZeroMQ streaming-pickle path of
+  ``txzmq/connection.py:483-516`` collapses to this);
+* launches the graphics server and posts periodic status JSON to the
+  web dashboard (``launcher.py:852-885``) when those services exist.
+"""
+
+import base64
+import pickle
+import threading
+import time
+import uuid
+
+from veles_tpu.cmdline import CommandLineArgumentsRegistry
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+
+
+def _encode(obj):
+    return base64.b64encode(pickle.dumps(obj, protocol=4)).decode("ascii")
+
+
+def _decode(blob):
+    return pickle.loads(base64.b64decode(blob.encode("ascii")))
+
+
+def parse_address(spec, default_host="0.0.0.0", default_port=5000):
+    """``host:port`` | ``:port`` | ``port`` → (host, port)."""
+    if isinstance(spec, (tuple, list)):
+        return tuple(spec)
+    spec = str(spec)
+    if ":" in spec:
+        host, port = spec.rsplit(":", 1)
+        return (host or default_host, int(port or default_port))
+    if spec.isdigit():
+        return (default_host, int(spec))
+    return (spec, default_port)
+
+
+class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
+    """Owns mode, device, coordinator and auxiliary services."""
+
+    #: kwargs consumed by the Launcher (the rest go to the workflow ctor).
+    KWARGS = frozenset([
+        "listen_address", "master_address", "device", "backend", "testing",
+        "stealth", "web_status", "graphics", "slave_death_probability",
+        "job_timeout", "heartbeat_timeout", "max_idle",
+    ])
+
+    def __init__(self, **kwargs):
+        super(Launcher, self).__init__()
+        unknown = set(kwargs) - self.KWARGS
+        if unknown:
+            raise TypeError("unknown Launcher kwargs: %s" % ", ".join(
+                sorted(unknown)))
+        self.listen_address = kwargs.get("listen_address")
+        self.master_address = kwargs.get("master_address")
+        if self.listen_address and self.master_address:
+            raise ValueError("cannot be both master (-l) and slave (-m)")
+        self.device = kwargs.get("device")
+        self.backend = kwargs.get("backend")
+        self.testing = kwargs.get("testing", False)
+        self.stealth = kwargs.get("stealth", False)
+        self.web_status = kwargs.get("web_status", False)
+        self.graphics = kwargs.get("graphics", True)
+        self.slave_death_probability = kwargs.get(
+            "slave_death_probability", 0.0)
+        self.job_timeout = kwargs.get("job_timeout")
+        self.heartbeat_timeout = kwargs.get("heartbeat_timeout", 10.0)
+        self.max_idle = kwargs.get("max_idle")
+        self.id = str(uuid.uuid4())
+        self.log_id = self.id[:8]
+        self.workflow = None
+        self.stopped = False
+        self.start_time = None
+        self._server = None
+        self._client = None
+        self._graphics_server = None
+        self._status_thread = None
+        self._finished = threading.Event()
+        self.plots_endpoints = ()
+
+    @staticmethod
+    def init_parser(parser):
+        parser.add_argument(
+            "-l", "--listen", dest="listen_address", default=None,
+            help="run as MASTER, listening for slaves on HOST:PORT")
+        parser.add_argument(
+            "-m", "--master", dest="master_address", default=None,
+            help="run as SLAVE of the master at HOST:PORT")
+        parser.add_argument(
+            "--test", dest="testing", action="store_true",
+            help="run the workflow in testing (forward-only) mode")
+        parser.add_argument(
+            "--slave-death-probability", type=float, default=0.0,
+            help="chaos: probability a slave dies mid-job (fault "
+                 "injection parity with the reference)")
+        parser.add_argument(
+            "--job-timeout", type=float, default=None,
+            help="master: drop a slave whose job overruns this many "
+                 "seconds (adaptive mean+3sigma otherwise)")
+        parser.add_argument(
+            "--no-graphics", dest="graphics", action="store_false",
+            help="do not launch the plotting service")
+        parser.add_argument(
+            "--web-status", action="store_true",
+            help="post periodic status JSON to the web dashboard")
+        return parser
+
+    # -- mode --------------------------------------------------------------
+
+    @property
+    def mode(self):
+        if self.listen_address:
+            return "master"
+        if self.master_address:
+            return "slave"
+        return "standalone"
+
+    @property
+    def is_standalone(self):
+        return self.mode == "standalone"
+
+    @property
+    def is_master(self):
+        return self.mode == "master"
+
+    @property
+    def is_slave(self):
+        return self.mode == "slave"
+
+    @property
+    def is_interactive(self):
+        return False
+
+    # -- workflow ownership (Unit.workflow protocol) -----------------------
+
+    def add_ref(self, workflow):
+        self.workflow = workflow
+
+    def del_ref(self, workflow):
+        if self.workflow is workflow:
+            self.workflow = None
+
+    def on_workflow_finished(self):
+        self._finished.set()
+        if self._server is not None:
+            self._server.no_more_jobs = True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def initialize(self, **kwargs):
+        """Create the device, initialize the workflow, start services."""
+        if self.workflow is None:
+            raise RuntimeError("no workflow attached to this launcher")
+        self.start_time = time.time()
+        if self.device is None and not self.is_master:
+            # masters do no compute — no device
+            from veles_tpu.backends import Device
+            self.device = Device(backend=self.backend)
+        if self.graphics and not root.common.disable.get("plotting", True):
+            self._launch_graphics()
+        self.workflow.add_finished_callback(self.on_workflow_finished)
+        self.workflow.initialize(device=self.device, **kwargs)
+        if self.is_master:
+            self._start_master()
+        elif self.is_slave:
+            self._connect_slave()
+        if self.web_status:
+            self._start_status_notifier()
+        return self
+
+    def _launch_graphics(self):
+        try:
+            from veles_tpu.graphics_server import GraphicsServer
+        except ImportError:
+            self.warning("graphics server unavailable; plots disabled")
+            return
+        self._graphics_server = GraphicsServer()
+        self.plots_endpoints = self._graphics_server.endpoints
+
+    def _start_master(self):
+        from veles_tpu.parallel.coordinator import (CoordinatorServer,
+                                                    NoMoreJobsError)
+        from veles_tpu.workflow import NoMoreJobs
+        workflow = self.workflow
+        # the master never calls workflow.run() (it does no compute), so
+        # lift the initial stopped state by hand before serving jobs
+        workflow.stopped = False
+
+        def job_source(slave):
+            try:
+                data = workflow.generate_data_for_slave(slave)
+            except NoMoreJobs:
+                raise NoMoreJobsError()
+            return {"blob": _encode(data)} if data is not None else None
+
+        def result_sink(data, slave):
+            workflow.apply_data_from_slave(_decode(data["blob"]), slave)
+
+        def on_drop(slave):
+            workflow.drop_slave(slave)
+
+        def initial_data_source(slave):
+            return _encode(workflow.generate_initial_data_for_slave(slave))
+
+        self._server = CoordinatorServer(
+            address=parse_address(self.listen_address),
+            checksum=workflow.checksum,
+            job_timeout=self.job_timeout,
+            heartbeat_timeout=self.heartbeat_timeout,
+            job_source=job_source, result_sink=result_sink,
+            on_drop=on_drop, initial_data_source=initial_data_source)
+        self.info("master listening on %s:%d", *self._server.address)
+
+    def _connect_slave(self):
+        from veles_tpu.parallel.coordinator import CoordinatorClient
+        self._client = CoordinatorClient(
+            parse_address(self.master_address, default_host="127.0.0.1"),
+            checksum=self.workflow.checksum,
+            power=self.workflow.computing_power,
+            death_probability=self.slave_death_probability)
+        self._client.connect()
+        self.info("connected to master as slave %s", self._client.id)
+        if self._client.initial_data is not None:
+            # the MASTER's negotiates_on_connect state, from the handshake
+            self.workflow.apply_initial_data_from_master(
+                _decode(self._client.initial_data))
+
+    def _start_status_notifier(self):
+        def notify():
+            interval = root.common.web.get("notification_interval", 1.0)
+            url = "http://%s:%d/update" % (root.common.web.host,
+                                           root.common.web.port)
+            import json
+            import urllib.request
+            while not self._finished.wait(interval):
+                try:
+                    payload = json.dumps(self.status()).encode()
+                    req = urllib.request.Request(
+                        url, data=payload,
+                        headers={"Content-Type": "application/json"})
+                    urllib.request.urlopen(req, timeout=2.0)
+                except Exception:
+                    pass
+
+        self._status_thread = threading.Thread(
+            target=notify, daemon=True, name="status-notifier")
+        self._status_thread.start()
+
+    def status(self):
+        """Periodic master status JSON (``launcher.py:852-885``)."""
+        wf = self.workflow
+        slaves = {}
+        if self._server is not None:
+            slaves = {sid: {"power": s.power, "state": s.state,
+                            "jobs_done": s.jobs_done}
+                      for sid, s in self._server.slaves.items()}
+        return {
+            "id": self.id, "log_id": self.log_id, "mode": self.mode,
+            "name": wf.name if wf else None,
+            "master": self.listen_address or "",
+            "time": time.time() - (self.start_time or time.time()),
+            "slaves": slaves,
+            "units": len(wf) if wf else 0,
+            "stopped": self.stopped,
+        }
+
+    def run(self):
+        """Run to completion in the current mode."""
+        try:
+            if self.is_master:
+                self._run_master()
+            elif self.is_slave:
+                self._run_slave()
+            else:
+                self.workflow.run()
+        finally:
+            self.stop()
+        return self.workflow
+
+    def _run_master(self):
+        # master does no compute: wait until the workflow declares
+        # NoMoreJobs (job_source side) or somebody calls stop()
+        while not self._finished.wait(0.1):
+            if self._server.no_more_jobs and not any(
+                    s.current_job for s in self._server.slaves.values()):
+                self._finished.set()
+
+    def _run_slave(self):
+        workflow = self.workflow
+
+        def handler(job):
+            update = [None]
+
+            def callback(data):
+                update[0] = data
+
+            workflow.do_job(_decode(job["blob"]), callback=callback)
+            return {"blob": _encode(update[0])}
+
+        self._client.serve_forever(handler, max_idle=self.max_idle)
+
+    def stop(self):
+        if self.stopped:
+            return
+        self.stopped = True
+        self._finished.set()
+        if self._client is not None:
+            self._client.close()
+        if self._server is not None:
+            self._server.stop()
+        if self._graphics_server is not None:
+            self._graphics_server.stop()
+
+    def __repr__(self):
+        return "<Launcher %s mode=%s>" % (self.log_id, self.mode)

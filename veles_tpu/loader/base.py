@@ -156,6 +156,10 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
             self.last_minibatch <<= False
 
     def run(self):
+        if self.is_slave:
+            # the minibatch was patched in by apply_data_from_master:
+            # a slave never advances the global serving order itself
+            return
         self.serve_next_minibatch()
 
     # -- the serving loop --------------------------------------------------
@@ -199,64 +203,60 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         prng.get(self.rand_name).shuffle(segment)
         indices[train_start:self.total_samples] = segment
 
-    def serve_next_minibatch(self, slave_id=None):
+    def serve_next_minibatch(self):
+        payload = self._next_payload()
+        self._apply_payload(payload)
+        self.samples_served += payload["size"]
+        self.event("minibatch", "single", klass=self.minibatch_class,
+                   size=payload["size"], epoch=self.epoch_number)
+
+    def _next_payload(self):
+        """One minibatch as a self-contained description.
+
+        A payload snapshots everything position-dependent — the actual
+        sample indices (not offsets: the permutation reshuffles between
+        epochs), epoch flags, class — so serving, sending to a slave,
+        and re-serving after a slave death are all exact replays.
+        """
         if self.failed_minibatches:
-            start, count = self.failed_minibatches.pop()
-            self._restore_failed(start, count)
-        else:
-            start, count = self._advance_global_offset()
-        if slave_id is not None:
-            self._pending_.setdefault(slave_id, []).append((start, count))
-        indices = self.shuffled_indices.map_read()[start:start + count]
+            # a dropped slave's minibatch is re-served before new ones
+            # (``loader/base.py:679-687`` fault-tolerance contract)
+            return self.failed_minibatches.pop()
+        start, count = self._advance_global_offset()
+        indices = numpy.asarray(
+            self.shuffled_indices.map_read()[start:start + count])
+        return {"indices": indices, "class": self.minibatch_class,
+                "start": start, "size": count,
+                "epoch": self.epoch_number,
+                "last": bool(self.last_minibatch),
+                "train_ended": bool(self.train_ended),
+                "epoch_ended": bool(self.epoch_ended)}
+
+    def _apply_payload(self, data):
+        count = data["size"]
+        self.minibatch_class = data["class"]
+        self.minibatch_size = count
+        self.minibatch_offset = data["start"] + count
+        self.epoch_number = data["epoch"]
+        self.last_minibatch <<= data["last"]
+        self.train_ended <<= data.get("train_ended", False)
+        self.epoch_ended <<= data["epoch_ended"]
         mb = self.minibatch_indices.map_invalidate()
-        mb[:count] = indices
+        mb[:count] = data["indices"]
         mb[count:] = -1  # pad short tails: static shapes for XLA
         self.on_before_fill()
         self.fill_minibatch()
-        self.samples_served += count
-        self.event("minibatch", "single", klass=self.minibatch_class,
-                   size=count, epoch=self.epoch_number)
-
-    def _restore_failed(self, start, count):
-        ends = self.class_end_offsets
-        for klass, end in enumerate(ends):
-            if start < end:
-                self.minibatch_class = klass
-                break
-        self.minibatch_size = count
-        self.minibatch_offset = start + count
-        # a requeued minibatch is mid-segment by definition: epoch flags
-        # must not carry over from the previous serve (double accounting)
-        self.last_minibatch <<= False
-        self.epoch_ended <<= False
-        self.train_ended <<= False
 
     # -- distribution (master serves indices only) -------------------------
 
     def generate_data_for_slave(self, slave=None):
-        start, count = self._advance_global_offset()
+        payload = self._next_payload()
         sid = getattr(slave, "id", slave)
-        self._pending_.setdefault(sid, []).append((start, count))
-        indices = self.shuffled_indices.map_read()[start:start + count]
-        return {"indices": numpy.asarray(indices),
-                "class": self.minibatch_class,
-                "start": start, "size": count,
-                "epoch": self.epoch_number,
-                "last": bool(self.last_minibatch),
-                "epoch_ended": bool(self.epoch_ended)}
+        self._pending_.setdefault(sid, []).append(payload)
+        return payload
 
     def apply_data_from_master(self, data):
-        count = data["size"]
-        self.minibatch_class = data["class"]
-        self.minibatch_size = count
-        self.epoch_number = data["epoch"]
-        self.last_minibatch <<= data["last"]
-        self.epoch_ended <<= data["epoch_ended"]
-        mb = self.minibatch_indices.map_invalidate()
-        mb[:count] = data["indices"]
-        mb[count:] = -1
-        self.on_before_fill()
-        self.fill_minibatch()
+        self._apply_payload(data)
 
     def generate_data_for_master(self):
         return {"served": self.samples_served}

@@ -182,14 +182,25 @@ class Array(object):
         with self._lock_:
             if self._state_ == DEV_DIRTY and self._devmem_ is not None:
                 self.mem = self.device.get(self._devmem_)
+            self._ensure_writable()
             self._state_ = HOST_DIRTY
         return self.mem
 
     def map_invalidate(self):
         """Host will overwrite entirely: skip the device→host copy."""
         with self._lock_:
+            if self.mem is not None and not self.mem.flags.writeable:
+                # caller overwrites everything: a fresh buffer suffices,
+                # no need to copy bytes that are about to be clobbered
+                self.mem = numpy.empty_like(self.mem)
             self._state_ = HOST_DIRTY
         return self.mem
+
+    def _ensure_writable(self):
+        # device→host views (numpy.asarray of a jax.Array) are read-only;
+        # a host write mapping must always hand out a mutable buffer
+        if self.mem is not None and not self.mem.flags.writeable:
+            self.mem = numpy.array(self.mem)
 
     def unmap(self):
         """Flush host writes to the device (upload if dirty)."""

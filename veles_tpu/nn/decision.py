@@ -59,16 +59,79 @@ class DecisionBase(Unit, IResultProvider):
     def run(self):
         klass = self.minibatch_class
         self.gd_skip <<= (klass != TRAIN)
+        metric = self.minibatch_metric()
+        if self.is_slave:
+            # one job = one minibatch: opening the end point after every
+            # pass makes Workflow.do_job() run exactly one iteration
+            # (the reference's slave-side job granularity,
+            # ``loader/base.py:631-639``). The MASTER does the
+            # authoritative epoch accounting from these updates — doing
+            # it locally too would corrupt best_metric/epoch_history
+            # with one slave's partial view.
+            self.complete <<= True
+            self._pending_update_ = {
+                "klass": klass, "samples": self.minibatch_size,
+                "metric": metric,
+                "epoch": self.epoch_number,
+                "last": bool(self.last_minibatch),
+                "epoch_ended": bool(self.epoch_ended)}
+            return
         stats = self.epoch_stats[klass]
         stats["samples"] += self.minibatch_size
-        stats["metric"] += self.minibatch_metric()
+        stats["metric"] += metric
         if bool(self.last_minibatch):
             self._on_class_finished(klass)
         if bool(self.epoch_ended):
             self._on_epoch_finished()
 
-    def _on_class_finished(self, klass):
-        stats = self.epoch_stats[klass]
+    # -- distribution: metrics ride slave→master, master decides stop ------
+
+    def generate_data_for_slave(self, slave=None):
+        # non-None payload so the slave's apply_data_from_master runs:
+        # it must re-arm the loop gate the previous job closed
+        return {"reset_complete": True}
+
+    def apply_data_from_master(self, data):
+        if data.get("reset_complete"):
+            self.complete <<= False
+
+    def generate_data_for_master(self):
+        update = getattr(self, "_pending_update_", None)
+        self._pending_update_ = None
+        return update
+
+    def apply_data_from_slave(self, data, slave=None):
+        """Master-side epoch accounting over all slaves' minibatches.
+
+        Stats accumulate in PER-EPOCH buckets: with several slaves the
+        first minibatches of epoch e+1 can return before the last
+        minibatch of epoch e, and a single shared accumulator would
+        misattribute them (wrong normalized metric, wrong early-stop).
+        """
+        if data is None:
+            return
+        buckets = getattr(self, "_epoch_buckets_", None)
+        if buckets is None:
+            buckets = self._epoch_buckets_ = {}
+        epoch = data.get("epoch", 0)
+        bucket = buckets.setdefault(
+            epoch, [dict(samples=0, metric=0.0) for _ in range(3)])
+        klass = data["klass"]
+        bucket[klass]["samples"] += data["samples"]
+        bucket[klass]["metric"] += data["metric"]
+        if data["last"]:
+            self._on_class_finished(klass, epoch=epoch, stats_set=bucket)
+        if data["epoch_ended"]:
+            self._on_epoch_finished(epoch=epoch, stats_set=bucket)
+            buckets.pop(epoch, None)
+        if bool(self.complete) and self.is_master:
+            # the master's workflow never runs: propagate the stop
+            # decision straight to the job source (NoMoreJobs)
+            self.workflow.stop()
+
+    def _on_class_finished(self, klass, epoch=None, stats_set=None):
+        epoch = self.epoch_number if epoch is None else epoch
+        stats = (self.epoch_stats if stats_set is None else stats_set)[klass]
         if not stats["samples"]:
             return
         normalized = stats["metric"] / stats["samples"]
@@ -78,24 +141,27 @@ class DecisionBase(Unit, IResultProvider):
             self.improved <<= normalized < self.best_metric
             if bool(self.improved):
                 self.best_metric = normalized
-                self.best_epoch = self.epoch_number
+                self.best_epoch = epoch
 
-    def _on_epoch_finished(self):
-        summary = {CLASS_NAMES[i]: dict(self.epoch_stats[i])
+    def _on_epoch_finished(self, epoch=None, stats_set=None):
+        # on a master, self.epoch_number (linked from the loader) may
+        # already have advanced past the epoch whose last update just
+        # arrived — callers with better knowledge pass the true epoch
+        epoch = self.epoch_number if epoch is None else epoch
+        stats_set = self.epoch_stats if stats_set is None else stats_set
+        summary = {CLASS_NAMES[i]: dict(stats_set[i])
                    for i in range(3) if self.class_lengths[i]}
-        summary["epoch"] = self.epoch_number
+        summary["epoch"] = epoch
         self.epoch_history.append(summary)
-        self.info("epoch %d: %s", self.epoch_number, "  ".join(
+        self.info("epoch %d: %s", epoch, "  ".join(
             "%s %s=%.4f" % (CLASS_NAMES[i], self.METRIC_NAME,
-                            self.epoch_stats[i].get("normalized",
-                                                    numpy.nan))
+                            stats_set[i].get("normalized", numpy.nan))
             for i in range(3) if self.class_lengths[i]))
         stop = False
-        if self.max_epochs is not None and \
-                self.epoch_number + 1 >= self.max_epochs:
+        if self.max_epochs is not None and epoch + 1 >= self.max_epochs:
             self.info("stopping: max_epochs=%d reached", self.max_epochs)
             stop = True
-        if self.epoch_number - self.best_epoch > self.fail_iterations:
+        if epoch - self.best_epoch > self.fail_iterations:
             self.info("stopping: no improvement in %d epochs",
                       self.fail_iterations)
             stop = True
@@ -138,10 +204,11 @@ class DecisionMSE(DecisionBase):
                 numpy.asarray(mse)[:self.minibatch_size]))
         return float(mse) * self.minibatch_size
 
-    def _on_class_finished(self, klass):
-        stats = self.epoch_stats[klass]
+    def _on_class_finished(self, klass, epoch=None, stats_set=None):
+        stats = (self.epoch_stats if stats_set is None else stats_set)[klass]
         if stats["samples"]:
             # report RMSE, compare on MSE (monotonic — same argmin)
             stats["metric_rmse"] = float(
                 numpy.sqrt(stats["metric"] / stats["samples"]))
-        super(DecisionMSE, self)._on_class_finished(klass)
+        super(DecisionMSE, self)._on_class_finished(
+            klass, epoch=epoch, stats_set=stats_set)

@@ -123,6 +123,40 @@ class GradientDescentBase(AcceleratedUnit):
     def numpy_run(self):
         self.jax_run()  # same pure math on host buffers
 
+    # -- distribution (the Znicz GD protocol re-imagined): master sends
+    # canonical weights with each job, the slave's local step produces a
+    # delta that the master merges additively — a point-to-point
+    # parameter-server exchange, exactly the reference's only training
+    # parallelism (SURVEY.md §2.4; hooks at ``units.py:157-164``) -------
+
+    def generate_data_for_slave(self, slave=None):
+        params = {k: numpy.array(v.map_read())
+                  for k, v in self.forward.param_arrays().items()}
+        return params or None
+
+    def apply_data_from_master(self, data):
+        base = {}
+        for k, value in (data or {}).items():
+            target = self.forward.param_arrays()[k]
+            mem = target.map_invalidate()
+            mem[...] = value
+            base[k] = value  # freshly unpickled: this frame owns it
+        self._job_base_params_ = base
+
+    def generate_data_for_master(self):
+        base = getattr(self, "_job_base_params_", None) or {}
+        out = {}
+        for k, arr in self.forward.param_arrays().items():
+            new = numpy.array(arr.map_read())
+            out[k] = new - base[k] if k in base else new
+        return out or None
+
+    def apply_data_from_slave(self, data, slave=None):
+        for k, delta in (data or {}).items():
+            target = self.forward.param_arrays()[k]
+            mem = target.map_write()
+            mem += delta
+
 
 # -- reference-parity aliases ------------------------------------------------
 

@@ -31,6 +31,10 @@ from veles_tpu import prng
 from veles_tpu.logger import Logger
 
 
+class NoMoreJobsError(Exception):
+    """Raised by a ``job_source`` when the workflow ran out of work."""
+
+
 class Protocol(object):
     """JSON-lines framing over a socket."""
 
@@ -77,11 +81,26 @@ class CoordinatorServer(Logger):
     """Master: accepts slaves, verifies checksum, farms jobs out."""
 
     def __init__(self, address=("127.0.0.1", 0), checksum="",
-                 job_timeout=None, heartbeat_timeout=10.0):
+                 job_timeout=None, heartbeat_timeout=10.0,
+                 job_source=None, result_sink=None, on_drop=None,
+                 initial_data_source=None):
         super(CoordinatorServer, self).__init__()
         self.checksum = checksum
         self.job_timeout = job_timeout
         self.heartbeat_timeout = heartbeat_timeout
+        # dynamic mode (master/slave training): when the static queue is
+        # empty, jobs come from job_source(slave) and results go to
+        # result_sink(data, slave) — the reference's per-slave
+        # generate_data_for_slave / apply_data_from_slave dispatch
+        # (``server.py:596-611``, ``server.py:401-414``).
+        self.job_source = job_source
+        self.result_sink = result_sink
+        self.on_drop = on_drop
+        # optional: payload delivered in the handshake reply so
+        # negotiates_on_connect units get the MASTER's state
+        # (``workflow.py:587-594`` generate_initial_data_for_slave)
+        self.initial_data_source = initial_data_source
+        self.no_more_jobs = False
         self.slaves = {}
         self.jobs = []                 # pending job payloads
         self.results = []
@@ -153,9 +172,18 @@ class CoordinatorServer(Logger):
 
     def drop_slave(self, sid):
         slave = self.slaves.pop(sid, None)
-        if slave is not None and slave.current_job is not None:
-            self.jobs.insert(0, slave.current_job[0])  # requeue first
-            slave.current_job = None
+        if slave is not None:
+            if slave.current_job is not None:
+                if self.on_drop is None:
+                    # static job farming: requeue the raw payload
+                    self.jobs.insert(0, slave.current_job[0])
+                slave.current_job = None
+            if self.on_drop is not None:
+                # dynamic mode: the workflow owns requeueing (e.g. the
+                # Loader moves pending minibatches to failed_minibatches
+                # and re-serves them) — re-inserting the stale payload
+                # here too would train the minibatch twice
+                self.on_drop(slave)
 
     # -- wire --------------------------------------------------------------
 
@@ -193,42 +221,14 @@ class CoordinatorServer(Logger):
                 self.slaves[sid] = SlaveDescription(
                     sid, hello.get("power", 1.0), hello.get("mid"),
                     hello.get("pid"))
-            proto.send({"id": sid, "log_id": sid})
+                slave_desc = self.slaves[sid]
+            reply = {"id": sid, "log_id": sid}
+            if self.initial_data_source is not None:
+                reply["data"] = self.initial_data_source(slave_desc)
+            proto.send(reply)
             while not self._done.is_set():
                 msg = proto.recv()
-                cmd = msg.get("cmd")
-                # compute the reply under the lock, send OUTSIDE it — a
-                # slow-reading peer must not stall the whole control plane
-                with self._lock:
-                    slave = self.slaves.get(sid)
-                    if slave is None:
-                        reply, stop = {"error": "dropped"}, True
-                    else:
-                        slave.last_seen = time.time()
-                        stop = False
-                        if cmd == "job":
-                            if self.jobs:
-                                payload = self.jobs.pop(0)
-                                slave.current_job = (payload, time.time())
-                                slave.state = "WORK"
-                                reply = {"job": payload}
-                            else:
-                                slave.state = "IDLE"
-                                reply = {"job": None}
-                        elif cmd == "result":
-                            if slave.current_job is not None:
-                                self.job_times.append(
-                                    time.time() - slave.current_job[1])
-                            slave.current_job = None
-                            slave.jobs_done += 1
-                            slave.state = "WAIT"
-                            self.results.append(msg.get("data"))
-                            reply = {"ok": True}
-                        elif cmd == "heartbeat":
-                            slave.power = msg.get("power", slave.power)
-                            reply = {"ok": True}
-                        else:
-                            reply = {"error": "unknown cmd %r" % cmd}
+                reply, stop = self._handle(sid, msg)
                 proto.send(reply)
                 if stop:
                     return
@@ -239,6 +239,69 @@ class CoordinatorServer(Logger):
                 with self._lock:
                     self.drop_slave(sid)
             proto.close()
+
+    def _handle(self, sid, msg):
+        """One request → (reply, stop).
+
+        Registry/queue state changes run under ``_lock``; the callbacks
+        into the workflow (``job_source``/``result_sink``) run OUTSIDE
+        it — with pod-scale payloads (full weight sets) their
+        pickle/merge time would otherwise starve the heartbeat path and
+        the reaper would drop live slaves mid-job. The workflow's own
+        per-unit data locks (``distributable.py``) protect its state.
+        """
+        cmd = msg.get("cmd")
+        action = None
+        with self._lock:
+            slave = self.slaves.get(sid)
+            if slave is None:
+                return {"error": "dropped"}, True
+            slave.last_seen = time.time()
+            if cmd == "job":
+                if self.jobs:
+                    payload = self.jobs.pop(0)
+                    slave.current_job = (payload, time.time())
+                    slave.state = "WORK"
+                    return {"job": payload}, False
+                if self.job_source is None or self.no_more_jobs:
+                    slave.state = "IDLE"
+                    return {"job": None, "done": self.no_more_jobs}, False
+                action = "source"
+            elif cmd == "result":
+                if slave.current_job is not None:
+                    self.job_times.append(
+                        time.time() - slave.current_job[1])
+                slave.current_job = None
+                slave.jobs_done += 1
+                slave.state = "WAIT"
+                if self.result_sink is None:
+                    self.results.append(msg.get("data"))
+                    return {"ok": True}, False
+                action = "sink"
+            elif cmd == "heartbeat":
+                slave.power = msg.get("power", slave.power)
+                return {"ok": True}, False
+            else:
+                return {"error": "unknown cmd %r" % cmd}, False
+
+        if action == "source":
+            payload = None
+            try:
+                payload = self.job_source(slave)
+            except NoMoreJobsError:
+                self.no_more_jobs = True
+            with self._lock:
+                if sid not in self.slaves:
+                    return {"error": "dropped"}, True
+                if payload is not None:
+                    slave.current_job = (payload, time.time())
+                    slave.state = "WORK"
+                    return {"job": payload}, False
+                slave.state = "IDLE"
+                return {"job": None, "done": self.no_more_jobs}, False
+        # action == "sink"
+        self.result_sink(msg.get("data"), slave)
+        return {"ok": True}, False
 
     def _serve_heartbeats(self, proto, sid):
         proto.send({"ok": sid in self.slaves})
@@ -293,6 +356,7 @@ class CoordinatorClient(Logger):
         if "error" in reply:
             raise ConnectionError(reply["error"])
         self.id = reply["id"]
+        self.initial_data = reply.get("data")
         # dedicated heartbeat channel so long handler() runs don't get
         # this slave declared dead mid-job
         hb_sock = socket.create_connection(self.address, timeout=10.0)
@@ -317,10 +381,16 @@ class CoordinatorClient(Logger):
         """Pull/execute/push until the queue stays empty (or forever)."""
         idle = 0
         while True:
-            self.proto.send({"cmd": "job"})
-            reply = self.proto.recv()
+            try:
+                self.proto.send({"cmd": "job"})
+                reply = self.proto.recv()
+            except (ConnectionError, OSError):
+                # master went away: nothing more to do for this slave
+                return self.jobs_done
             job = reply.get("job")
             if job is None:
+                if reply.get("done"):
+                    return self.jobs_done
                 idle += 1
                 if max_idle is not None and idle >= max_idle:
                     return self.jobs_done

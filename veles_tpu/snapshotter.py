@@ -113,8 +113,6 @@ class SnapshotterToFile(SnapshotterBase):
     snapshot (``veles/snapshotter.py:387-409``).
     """
 
-    WRITE_ATTEMPTS = 2
-
     def __init__(self, workflow, **kwargs):
         self.directory = kwargs.pop(
             "directory", root.common.dirs.get("snapshots", "."))
@@ -198,12 +196,14 @@ def load_workflow(path_or_bytes):
     for key, gen in blob.get("random", {}).items():
         prng._generators[key] = gen
     workflow = blob["workflow"]
-    workflow._restored_from_snapshot_ = True
-    for unit in workflow:
-        unit._restored_from_snapshot_ = True
-        if hasattr(unit, "__iter__") and unit is not workflow:
-            for sub in unit:  # nested workflows
-                sub._restored_from_snapshot_ = True
+    def mark(container):
+        container._restored_from_snapshot_ = True
+        for unit in container:
+            unit._restored_from_snapshot_ = True
+            if hasattr(unit, "__iter__"):  # nested workflows, any depth
+                mark(unit)
+
+    mark(workflow)
     if workflow.checksum != blob["checksum"]:
         workflow.warning("restored workflow checksum differs from the "
                          "one recorded at snapshot time")

@@ -17,7 +17,7 @@ import time
 
 from veles_tpu.config import root
 from veles_tpu.mutable import Bool
-from veles_tpu.plumbing import StartPoint, EndPoint
+from veles_tpu.plumbing import StartPoint, EndPoint, Repeater
 from veles_tpu.units import Container, Unit
 
 
@@ -166,8 +166,13 @@ class Workflow(Container):
             dst, src = signals.popleft()
             if self._aborted_:
                 continue
-            if bool(self.stopped) and isinstance(dst, EndPoint):
-                continue  # the end point already ran once
+            if bool(self.stopped) and isinstance(dst, (EndPoint, Repeater)):
+                # the end point already ran once; Repeaters anchor loops,
+                # so blocking them after the stop guarantees termination
+                # even for cycles whose gates are not wired to the stop
+                # condition — in-flight units of the current iteration
+                # still finish (snapshot-exactness contract)
+                continue
             if bool(dst.gate_block):
                 continue
             if not dst.open_gate(src):
