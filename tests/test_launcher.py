@@ -80,6 +80,41 @@ def test_master_slave_training():
     assert wf_slave is not None
 
 
+def test_two_slaves_close_epochs_exactly():
+    """With two concurrent slaves, epochs must close exactly once each
+    and only when all their minibatch updates have arrived."""
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    master = Launcher(listen_address="127.0.0.1:0", graphics=False)
+    wf_master = _make_workflow(master, max_epochs=3)
+    master.initialize()
+    port = master._server.address[1]
+
+    slaves = []
+    for _ in range(2):
+        prng.get().seed(42)
+        prng.get("loader").seed(43)
+        slave = Launcher(master_address="127.0.0.1:%d" % port,
+                         graphics=False)
+        _make_workflow(slave, max_epochs=3)
+        slave.initialize()
+        slaves.append(slave)
+    threads = [threading.Thread(target=s.run, daemon=True) for s in slaves]
+    for t in threads:
+        t.start()
+    master.run()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    history = wf_master.decision.epoch_history
+    assert [h["epoch"] for h in history] == [0, 1, 2], history
+    total = sum(wf_master.loader.class_lengths)
+    for h in history:
+        served = sum(h[k]["samples"] for k in ("validation", "train")
+                     if k in h)
+        assert served == total, h
+
+
 def test_slave_death_requeues_minibatch():
     """A slave dying mid-epoch must not lose its minibatch: the loader
     re-serves it and the master still closes every epoch exactly once."""

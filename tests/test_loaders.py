@@ -1,0 +1,270 @@
+"""Loader hierarchy tests (SURVEY.md §2.3): image/hdf5/pickles/saver/
+interactive/socket-fed loaders + Downloader, InputJoiner,
+MeanDispNormalizer, Avatar units."""
+
+import json
+import os
+import pickle
+import socket
+import threading
+import zipfile
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
+
+
+def _init_loader(loader, device=None):
+    loader.initialize(device=device)
+    return loader
+
+
+# -- image --------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def image_tree(tmp_path_factory):
+    from PIL import Image
+    base = tmp_path_factory.mktemp("images")
+    rng = numpy.random.RandomState(0)
+    for split, n in (("train", 6), ("valid", 4)):
+        for label in ("cat", "dog"):
+            d = base / split / label
+            d.mkdir(parents=True)
+            for i in range(n):
+                arr = (rng.rand(8, 8, 3) * 255).astype(numpy.uint8)
+                Image.fromarray(arr).save(d / ("img%d.png" % i))
+    return base
+
+
+def test_file_image_loader(image_tree):
+    from veles_tpu.loader.image import FileImageLoader
+    prng.get("loader").seed(1)
+    loader = FileImageLoader(
+        DummyWorkflow(), train_paths=(str(image_tree / "train"),),
+        validation_paths=(str(image_tree / "valid"),),
+        minibatch_size=4)
+    _init_loader(loader)
+    assert loader.class_lengths == [0, 8, 12]
+    assert loader.labels_mapping == {"cat": 0, "dog": 1}
+    assert loader.original_data.shape == (20, 8, 8, 3)
+    assert loader.original_data.mem.dtype == numpy.float32
+    assert float(loader.original_data.mem.max()) <= 1.0
+    loader.run()
+    assert loader.minibatch_data.shape[0] == 4
+
+
+def test_file_image_loader_mirror(image_tree):
+    from veles_tpu.loader.image import FileImageLoader
+    loader = FileImageLoader(
+        DummyWorkflow(), train_paths=(str(image_tree / "train"),),
+        mirror=True, minibatch_size=4)
+    _init_loader(loader)
+    assert loader.class_lengths[TRAIN] == 24  # doubled by flips
+    data = loader.original_data.mem
+    assert numpy.allclose(data[0], data[1][:, ::-1])
+
+
+def test_auto_label_image_loader(image_tree):
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+    loader = AutoLabelFileImageLoader(
+        DummyWorkflow(), train_paths=(str(image_tree / "train"),),
+        label_regexp=r"img(\d+)", minibatch_size=4)
+    _init_loader(loader)
+    assert set(loader.labels_mapping) == {"0", "1", "2", "3", "4", "5"}
+
+
+# -- hdf5 / pickles ------------------------------------------------------
+
+
+def test_hdf5_loader(tmp_path):
+    import h5py
+    from veles_tpu.loader.hdf5 import HDF5Loader
+    rng = numpy.random.RandomState(0)
+    for name, n in (("train.h5", 30), ("valid.h5", 10)):
+        with h5py.File(tmp_path / name, "w") as f:
+            f["data"] = rng.rand(n, 5).astype(numpy.float32)
+            f["labels"] = rng.randint(0, 3, n).astype(numpy.int32)
+    prng.get("loader").seed(1)
+    loader = HDF5Loader(DummyWorkflow(),
+                        train_path=str(tmp_path / "train.h5"),
+                        validation_path=str(tmp_path / "valid.h5"),
+                        minibatch_size=8)
+    _init_loader(loader)
+    assert loader.class_lengths == [0, 10, 30]
+    loader.run()
+    assert loader.minibatch_size == 8
+
+
+def test_pickles_loader(tmp_path):
+    from veles_tpu.loader.pickles import PicklesLoader
+    rng = numpy.random.RandomState(0)
+    data = rng.rand(20, 4).astype(numpy.float32)
+    labels = rng.randint(0, 2, 20).astype(numpy.int32)
+    with open(tmp_path / "train.pickle", "wb") as f:
+        pickle.dump((data, labels), f)
+    loader = PicklesLoader(DummyWorkflow(),
+                           train_path=str(tmp_path / "train.pickle"),
+                           minibatch_size=5)
+    _init_loader(loader)
+    assert loader.class_lengths == [0, 0, 20]
+    assert numpy.allclose(loader.original_data.mem, data)
+
+
+# -- saver / replay ------------------------------------------------------
+
+
+def test_minibatches_saver_roundtrip(tmp_path):
+    from veles_tpu.loader.pickles import PicklesLoader
+    from veles_tpu.loader.saver import MinibatchesLoader, MinibatchesSaver
+    rng = numpy.random.RandomState(3)
+    data = rng.rand(12, 4).astype(numpy.float32)
+    labels = rng.randint(0, 2, 12).astype(numpy.int32)
+    with open(tmp_path / "train.pickle", "wb") as f:
+        pickle.dump((data, labels), f)
+
+    wf = DummyWorkflow()
+    prng.get("loader").seed(5)
+    source = PicklesLoader(wf, train_path=str(tmp_path / "train.pickle"),
+                           minibatch_size=4, shuffle_limit=0)
+    _init_loader(source)
+    rec = str(tmp_path / "mb.vtpu")
+    saver = MinibatchesSaver(wf, file_name=rec)
+    saver.link_attrs(source, "minibatch_data", "minibatch_labels",
+                     "minibatch_size", "minibatch_class",
+                     "last_minibatch", "epoch_ended", "class_lengths",
+                     "max_minibatch_size")
+    saver.initialize()
+    for _ in range(3):  # one full epoch: 12 samples / 4
+        source.run()
+        saver.run()
+    saver.close()
+
+    prng.get("loader").seed(5)
+    replay = MinibatchesLoader(DummyWorkflow(), file_name=rec,
+                               minibatch_size=4, shuffle_limit=0)
+    _init_loader(replay)
+    assert replay.class_lengths == [0, 0, 12]
+    replay.run()
+    # unshuffled replay serves the same first minibatch the source did
+    assert replay.minibatch_data.mem.shape == (4, 4)
+
+
+# -- interactive / socket-fed --------------------------------------------
+
+
+def test_interactive_loader_feeds():
+    from veles_tpu.loader.interactive import InteractiveLoader
+    loader = InteractiveLoader(DummyWorkflow(), sample_shape=(3,))
+    _init_loader(loader)
+    loader.feed([1.0, 2.0, 3.0])
+    loader.run()
+    assert numpy.allclose(loader.minibatch_data.mem[0], [1, 2, 3])
+    assert loader.minibatch_class == TEST
+
+
+def test_socket_fed_loader():
+    from veles_tpu.zmq_loader import SocketFedLoader
+    loader = SocketFedLoader(DummyWorkflow(), sample_shape=(2,))
+    _init_loader(loader)
+    try:
+        with socket.create_connection(loader.address, timeout=5) as sock:
+            f = sock.makefile("rwb")
+            f.write(json.dumps({"data": [4.0, 5.0]}).encode() + b"\n")
+            f.flush()
+            assert json.loads(f.readline())["ok"]
+        loader.run()
+        assert numpy.allclose(loader.minibatch_data.mem[0], [4, 5])
+    finally:
+        loader.stop_serving()
+
+
+# -- downloader ----------------------------------------------------------
+
+
+def test_downloader_unpacks_zip(tmp_path):
+    from veles_tpu.downloader import Downloader
+    archive = tmp_path / "data.zip"
+    with zipfile.ZipFile(archive, "w") as z:
+        z.writestr("dataset/a.txt", "hello")
+    target = tmp_path / "out"
+    unit = Downloader(DummyWorkflow(), url="file://" + str(archive),
+                      directory=str(target),
+                      files=("dataset/a.txt",))
+    unit.initialize()
+    assert (target / "dataset" / "a.txt").read_text() == "hello"
+    # idempotent: second initialize is a no-op
+    unit.initialize()
+
+
+def test_downloader_missing_file_raises(tmp_path):
+    from veles_tpu.downloader import Downloader
+    archive = tmp_path / "data.zip"
+    with zipfile.ZipFile(archive, "w") as z:
+        z.writestr("other.txt", "x")
+    unit = Downloader(DummyWorkflow(), url="file://" + str(archive),
+                      directory=str(tmp_path / "out2"),
+                      files=("missing.txt",))
+    with pytest.raises(FileNotFoundError):
+        unit.initialize()
+
+
+# -- joiner / normalizer / avatar ----------------------------------------
+
+
+def test_input_joiner(tmp_path):
+    from veles_tpu.input_joiner import InputJoiner
+    from veles_tpu.memory import Array
+    a = Array(numpy.arange(12, dtype=numpy.float32).reshape(3, 4))
+    b = Array(numpy.arange(6, dtype=numpy.float32).reshape(3, 2))
+    joiner = InputJoiner(DummyWorkflow(), num_inputs=2)
+    joiner.input_0 = a
+    joiner.input_1 = b
+    joiner.initialize(device=Device(backend="cpu"))
+    joiner.run()
+    out = joiner.output.map_read()
+    assert out.shape == (3, 6)
+    assert numpy.allclose(out[:, :4], a.mem.reshape(3, 4))
+    assert numpy.allclose(out[:, 4:], b.mem)
+
+
+def test_mean_disp_normalizer():
+    from veles_tpu.mean_disp_normalizer import MeanDispNormalizer
+    from veles_tpu.memory import Array
+    rng = numpy.random.RandomState(0)
+    x = rng.rand(5, 3).astype(numpy.float32) * 10
+    mean = x.mean(axis=0)
+    spread = x.max(axis=0) - x.min(axis=0)
+    unit = MeanDispNormalizer(DummyWorkflow())
+    unit.input = Array(x)
+    unit.mean = Array(mean)
+    unit.rdisp = Array((1.0 / spread).astype(numpy.float32))
+    unit.initialize(device=Device(backend="cpu"))
+    unit.run()
+    out = unit.output.map_read()
+    assert numpy.allclose(out, (x - mean) / spread, atol=1e-5)
+
+
+def test_avatar_mirrors_attrs():
+    from veles_tpu.avatar import Avatar
+    from veles_tpu.memory import Array
+
+    class Source(object):
+        pass
+
+    src = Source()
+    src.values = Array(numpy.ones(4, numpy.float32))
+    src.count = 7
+    avatar = Avatar(DummyWorkflow(), source=src, attrs=("values", "count"))
+    avatar.initialize()
+    assert avatar.count == 7
+    src.count = 9
+    src.values.mem[...] = 2.0
+    assert numpy.allclose(avatar.values.mem, 1.0)  # decoupled snapshot
+    avatar.run()
+    assert avatar.count == 9
+    assert numpy.allclose(avatar.values.mem, 2.0)

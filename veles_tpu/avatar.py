@@ -1,0 +1,44 @@
+"""Avatar unit (re-designs ``veles/avatar.py:22``).
+
+Mirrors a chosen set of attributes from a source unit each time it runs
+— the mechanism the reference used to expose one workflow's state to
+another across process boundaries. In-process it is an attribute
+snapshot barrier: downstream units see a consistent copy taken at a
+well-defined point of the graph, decoupled from the source's later
+mutations.
+"""
+
+import numpy
+
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+
+
+class Avatar(Unit):
+    """Copies ``attrs`` from ``source`` onto itself on every run."""
+
+    def __init__(self, workflow, **kwargs):
+        self.attrs = tuple(kwargs.pop("attrs", ()))
+        source = kwargs.pop("source", None)
+        super(Avatar, self).__init__(workflow, **kwargs)
+        self.source = source
+        self.demand("source")
+
+    def clone(self):
+        for attr in self.attrs:
+            value = getattr(self.source, attr)
+            if isinstance(value, Array):
+                mirror = getattr(self, attr, None)
+                if not isinstance(mirror, Array):
+                    mirror = Array()
+                    setattr(self, attr, mirror)
+                mirror.reset(numpy.array(value.map_read(), copy=True))
+            else:
+                import copy
+                setattr(self, attr, copy.deepcopy(value))
+
+    def initialize(self, **kwargs):
+        self.clone()
+
+    def run(self):
+        self.clone()

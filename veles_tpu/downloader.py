@@ -1,0 +1,80 @@
+"""Dataset downloader unit (re-designs ``veles/downloader.py:56``).
+
+At workflow initialize time, if the target directory does not already
+contain the expected files, fetch an archive from ``url`` and unpack it.
+Supports ``file://`` and ``http(s)://`` URLs and ``.zip``/``.tar*``
+archives. Runs before any loader touches the data (link it ahead of the
+loader or just construct it first — it does all work in initialize()).
+"""
+
+import os
+import tarfile
+import urllib.parse
+import urllib.request
+import zipfile
+
+from veles_tpu.config import root
+from veles_tpu.units import TrivialUnit
+
+
+class Downloader(TrivialUnit):
+    """Fetch + unpack a dataset archive if not already present."""
+
+    view_group = "SERVICE"
+
+    def __init__(self, workflow, **kwargs):
+        self.url = kwargs.pop("url")
+        self.directory = kwargs.pop(
+            "directory", root.common.dirs.get("datasets", "."))
+        #: files whose presence means the dataset is already there
+        self.files = tuple(kwargs.pop("files", ()))
+        super(Downloader, self).__init__(workflow, **kwargs)
+
+    def initialize(self, **kwargs):
+        if self.files and all(
+                os.path.exists(os.path.join(self.directory, name))
+                for name in self.files):
+            self.debug("dataset already present in %s", self.directory)
+            return
+        os.makedirs(self.directory, exist_ok=True)
+        archive = self._fetch()
+        try:
+            self._unpack(archive)
+        finally:
+            if archive.startswith(self.directory):
+                os.unlink(archive)
+        missing = [name for name in self.files if not os.path.exists(
+            os.path.join(self.directory, name))]
+        if missing:
+            raise FileNotFoundError(
+                "archive from %s did not provide: %s" %
+                (self.url, ", ".join(missing)))
+
+    def _fetch(self):
+        parsed = urllib.parse.urlparse(self.url)
+        name = os.path.basename(parsed.path)
+        if parsed.scheme in ("", "file"):
+            return urllib.request.url2pathname(parsed.path)
+        target = os.path.join(self.directory, name)
+        self.info("downloading %s", self.url)
+        with urllib.request.urlopen(self.url) as response, \
+                open(target, "wb") as out:
+            while True:
+                chunk = response.read(1 << 20)
+                if not chunk:
+                    break
+                out.write(chunk)
+        return target
+
+    def _unpack(self, archive):
+        self.info("unpacking %s to %s", archive, self.directory)
+        if zipfile.is_zipfile(archive):
+            with zipfile.ZipFile(archive) as z:
+                z.extractall(self.directory)  # noqa: S202 — trusted source
+        elif tarfile.is_tarfile(archive):
+            with tarfile.open(archive) as t:
+                t.extractall(self.directory)  # noqa: S202
+        else:
+            # plain file: place it under the target directory as-is
+            import shutil
+            shutil.copy(archive, self.directory)

@@ -1,0 +1,56 @@
+"""InputJoiner unit (re-designs ``veles/input_joiner.py:49``).
+
+Concatenates several input Arrays along the feature axis into one
+output, on device. The reference jinja-templated a per-input OpenCL copy
+kernel (``ocl/join.jcl``); here XLA's concatenate does the packing and
+fuses with neighbors (:func:`veles_tpu.ops.join.join_arrays`).
+"""
+
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+from veles_tpu.ops.join import join_arrays
+
+
+class InputJoiner(AcceleratedUnit):
+    """output = concat(flatten(input_0), flatten(input_1), ...)."""
+
+    def __init__(self, workflow, **kwargs):
+        self.num_inputs = kwargs.pop("num_inputs", 2)
+        super(InputJoiner, self).__init__(workflow, **kwargs)
+        self.output = Array()
+        for i in range(self.num_inputs):
+            setattr(self, "input_%d" % i, None)
+        self.demand(*("input_%d" % i for i in range(self.num_inputs)))
+
+    @property
+    def inputs(self):
+        return [getattr(self, "input_%d" % i)
+                for i in range(self.num_inputs)]
+
+    def _input_mems(self):
+        return [inp.mem if isinstance(inp, Array) else numpy.asarray(inp)
+                for inp in self.inputs]
+
+    def initialize(self, device=None, **kwargs):
+        super(InputJoiner, self).initialize(device=device, **kwargs)
+        mems = self._input_mems()
+        batch = mems[0].shape[0]
+        width = sum(int(numpy.prod(m.shape[1:])) for m in mems)
+        self.output.reset(numpy.zeros((batch, width), numpy.float32))
+        self.init_vectors(self.output,
+                          *(i for i in self.inputs if isinstance(i, Array)))
+
+    def jax_run(self):
+        devmems = [inp.devmem if isinstance(inp, Array) else inp
+                   for inp in self.inputs]
+        for inp in self.inputs:
+            if isinstance(inp, Array):
+                inp.unmap()
+        self.output.assign_devmem(join_arrays(*devmems))
+
+    def numpy_run(self):
+        mems = [m.reshape(m.shape[0], -1) for m in self._input_mems()]
+        out = self.output.map_invalidate()
+        out[...] = numpy.concatenate(mems, axis=1)

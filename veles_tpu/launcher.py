@@ -267,9 +267,9 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         wf = self.workflow
         slaves = {}
         if self._server is not None:
-            slaves = {sid: {"power": s.power, "state": s.state,
-                            "jobs_done": s.jobs_done}
-                      for sid, s in self._server.slaves.items()}
+            slaves = {s.id: {"power": s.power, "state": s.state,
+                             "jobs_done": s.jobs_done}
+                      for s in self._server.snapshot_slaves()}
         return {
             "id": self.id, "log_id": self.log_id, "mode": self.mode,
             "name": wf.name if wf else None,
@@ -298,7 +298,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         # NoMoreJobs (job_source side) or somebody calls stop()
         while not self._finished.wait(0.1):
             if self._server.no_more_jobs and not any(
-                    s.current_job for s in self._server.slaves.values()):
+                    s.current_job or s.applying
+                    for s in self._server.snapshot_slaves()):
                 self._finished.set()
 
     def _run_slave(self):

@@ -10,3 +10,12 @@ from veles_tpu.loader.base import (CLASS_NAMES, TEST, TRAIN, VALIDATION,  # noqa
                                    Loader, UserLoaderRegistry)
 from veles_tpu.loader.fullbatch import (FullBatchLoader,  # noqa: F401
                                         FullBatchLoaderMSE)
+from veles_tpu.loader.hdf5 import HDF5Loader  # noqa: F401
+from veles_tpu.loader.image import (AutoLabelFileImageLoader,  # noqa: F401
+                                    FileImageLoader, ImageLoaderMSE)
+from veles_tpu.loader.interactive import (InteractiveLoader,  # noqa: F401
+                                          QueueFedLoader)
+from veles_tpu.loader.pickles import PicklesLoader  # noqa: F401
+from veles_tpu.loader.restful import RestfulLoader  # noqa: F401
+from veles_tpu.loader.saver import (MinibatchesLoader,  # noqa: F401
+                                    MinibatchesSaver)

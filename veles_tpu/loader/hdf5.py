@@ -1,0 +1,61 @@
+"""HDF5 dataset loader (re-designs ``veles/loader/loader_hdf5.py``).
+
+Each class (test/validation/train) comes from one ``.h5`` file holding
+two datasets: ``data`` (N × sample shape) and ``labels`` (N,). Files are
+read once at initialize and staged into the device-resident full batch.
+h5py is optional: the import only happens when a file is actually read.
+"""
+
+import numpy
+
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+
+class HDF5Loader(FullBatchLoader):
+    """test_path/validation_path/train_path → device-resident batch."""
+
+    DATA_DATASET = "data"
+    LABELS_DATASET = "labels"
+
+    def __init__(self, workflow, **kwargs):
+        self.test_path = kwargs.pop("test_path", None)
+        self.validation_path = kwargs.pop("validation_path", None)
+        self.train_path = kwargs.pop("train_path", None)
+        super(HDF5Loader, self).__init__(workflow, **kwargs)
+
+    def _read(self, path):
+        try:
+            import h5py
+        except ImportError:
+            raise ImportError("HDF5Loader needs h5py; it is not installed")
+        with h5py.File(path, "r") as f:
+            data = numpy.asarray(f[self.DATA_DATASET], numpy.float32)
+            labels = None
+            if self.LABELS_DATASET in f:
+                labels = numpy.asarray(f[self.LABELS_DATASET],
+                                       numpy.int32)
+        return data, labels
+
+    def load_dataset(self):
+        data_parts, label_parts = [], []
+        for klass, path in enumerate((self.test_path,
+                                      self.validation_path,
+                                      self.train_path)):
+            if path is None:
+                continue
+            data, labels = self._read(path)
+            self.class_lengths[klass] = len(data)
+            data_parts.append(data)
+            if labels is not None:
+                if len(labels) != len(data):
+                    raise ValueError(
+                        "%s: %d labels for %d samples in %s" %
+                        (self.name, len(labels), len(data), path))
+                label_parts.append(labels)
+        if not data_parts:
+            raise ValueError("%s: no HDF5 paths given" % self.name)
+        self.original_data.reset(numpy.concatenate(data_parts))
+        if label_parts:
+            self.original_labels.reset(numpy.concatenate(label_parts))
+        else:
+            self.has_labels = False

@@ -1,0 +1,196 @@
+"""Image loaders: directory scanning, decoding, labels from paths.
+
+Re-designs the reference's PIL-based image loader family
+(``veles/loader/image.py``, ``veles/loader/file_image.py:150``,
+``veles/loader/fullbatch_image.py``). The reference streamed images per
+minibatch through host RAM; on TPU the right shape is the opposite —
+decode once at initialize time into the device-resident full batch
+(HBM), then the hot loop is pure on-device gather (no PIL, no host
+traffic). Augmentation that the reference did per-sample on the host
+(mirror/crop) is applied at staging time.
+
+PIL is an optional dependency: importing this module without it raises
+only when a loader is actually used.
+"""
+
+import os
+import re
+
+import numpy
+
+from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
+from veles_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
+
+#: file extensions accepted by the directory scanners
+IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tif",
+                    ".tiff", ".webp")
+
+
+def _pil():
+    try:
+        from PIL import Image
+    except ImportError:
+        raise ImportError(
+            "image loaders need Pillow (PIL); it is not installed")
+    return Image
+
+
+def decode_image(path, size=None, color="RGB"):
+    """Decode one image file → float32 HWC array in [0, 1]."""
+    Image = _pil()
+    with Image.open(path) as img:
+        img = img.convert(color)
+        if size is not None:
+            img = img.resize((size[1], size[0]), Image.BILINEAR)
+        arr = numpy.asarray(img, dtype=numpy.float32) / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+class ImageScanner(object):
+    """Collects (path, label_name) pairs from a directory tree.
+
+    Labels come from the immediate parent directory name — the
+    reference's path-derived labeling (``loader/file_image.py``).
+    """
+
+    def __init__(self, ignored_dirs=(), filename_re=None):
+        self.ignored_dirs = set(ignored_dirs)
+        self.filename_re = re.compile(filename_re) if filename_re else None
+
+    def scan(self, base):
+        found = []
+        for dirpath, dirnames, filenames in sorted(os.walk(base)):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in self.ignored_dirs)
+            for name in sorted(filenames):
+                if not name.lower().endswith(IMAGE_EXTENSIONS):
+                    continue
+                if self.filename_re and not self.filename_re.search(name):
+                    continue
+                label = os.path.basename(os.path.dirname(
+                    os.path.join(dirpath, name)))
+                found.append((os.path.join(dirpath, name), label))
+        return found
+
+
+class FileImageLoader(FullBatchLoader):
+    """Scans test/validation/train directory trees into a device-resident
+    full batch; labels from directory names (``file_image.py:150``)."""
+
+    def __init__(self, workflow, **kwargs):
+        self.test_paths = tuple(kwargs.pop("test_paths", ()))
+        self.validation_paths = tuple(kwargs.pop("validation_paths", ()))
+        self.train_paths = tuple(kwargs.pop("train_paths", ()))
+        self.size = kwargs.pop("size", None)        # (H, W) resize target
+        self.color_space = kwargs.pop("color_space", "RGB")
+        self.mirror = kwargs.pop("mirror", False)   # train-time flip copies
+        self.filename_re = kwargs.pop("filename_re", None)
+        self.ignored_dirs = kwargs.pop("ignored_dirs", ())
+        super(FileImageLoader, self).__init__(workflow, **kwargs)
+        self.labels_mapping = {}
+
+    def _scan_class(self, paths):
+        scanner = ImageScanner(self.ignored_dirs, self.filename_re)
+        pairs = []
+        for base in paths:
+            pairs.extend(scanner.scan(base))
+        return pairs
+
+    def load_dataset(self):
+        per_class = [self._scan_class(p) for p in
+                     (self.test_paths, self.validation_paths,
+                      self.train_paths)]
+        names = sorted({label for pairs in per_class
+                        for _, label in pairs})
+        self.labels_mapping = {name: i for i, name in enumerate(names)}
+        if not any(per_class):
+            raise ValueError("%s found no images" % self.name)
+        if self.size is None:
+            # infer from the first image so all samples stack
+            first = next(p for pairs in per_class for p, _ in pairs)
+            self.size = decode_image(first, color=self.color_space
+                                     ).shape[:2]
+        data, labels = [], []
+        for klass, pairs in enumerate(per_class):
+            count = 0
+            for path, label in pairs:
+                img = decode_image(path, self.size, self.color_space)
+                data.append(img)
+                labels.append(self.labels_mapping[label])
+                count += 1
+                if self.mirror and klass == TRAIN:
+                    data.append(img[:, ::-1])
+                    labels.append(self.labels_mapping[label])
+                    count += 1
+            self.class_lengths[klass] = count
+        self.original_data.reset(numpy.stack(data).astype(numpy.float32))
+        self.original_labels.reset(numpy.asarray(labels, numpy.int32))
+
+    @property
+    def n_classes(self):
+        return len(self.labels_mapping)
+
+
+class AutoLabelFileImageLoader(FileImageLoader):
+    """Labels extracted from the FILE name by a regex capture group
+    (the reference's FullBatchAutoLabelFileImageLoader)."""
+
+    def __init__(self, workflow, **kwargs):
+        self.label_regexp = re.compile(kwargs.pop("label_regexp"))
+        super(AutoLabelFileImageLoader, self).__init__(workflow, **kwargs)
+
+    def _scan_class(self, paths):
+        pairs = super(AutoLabelFileImageLoader, self)._scan_class(paths)
+        relabeled = []
+        for path, _ in pairs:
+            match = self.label_regexp.search(os.path.basename(path))
+            if match is None:
+                continue
+            relabeled.append((path, match.group(1)))
+        return relabeled
+
+
+class ImageLoaderMSE(FullBatchLoaderMSE):
+    """Image → image regression (the reference's ``image_mse.py``):
+    targets are images too, matched to inputs by index."""
+
+    def __init__(self, workflow, **kwargs):
+        self.test_paths = tuple(kwargs.pop("test_paths", ()))
+        self.validation_paths = tuple(kwargs.pop("validation_paths", ()))
+        self.train_paths = tuple(kwargs.pop("train_paths", ()))
+        self.target_paths = tuple(kwargs.pop("target_paths", ()))
+        self.size = kwargs.pop("size", None)
+        self.color_space = kwargs.pop("color_space", "RGB")
+        super(ImageLoaderMSE, self).__init__(workflow, **kwargs)
+
+    def load_dataset(self):
+        scanner = ImageScanner()
+        data = []
+        for klass, paths in enumerate((self.test_paths,
+                                       self.validation_paths,
+                                       self.train_paths)):
+            pairs = []
+            for base in paths:
+                pairs.extend(scanner.scan(base))
+            if pairs and self.size is None:
+                self.size = decode_image(
+                    pairs[0][0], color=self.color_space).shape[:2]
+            imgs = [decode_image(p, self.size, self.color_space)
+                    for p, _ in pairs]
+            data.extend(imgs)
+            self.class_lengths[klass] = len(imgs)
+        self.original_data.reset(numpy.stack(data).astype(numpy.float32))
+        self.has_labels = False
+        targets = []
+        for base in self.target_paths:
+            targets.extend(decode_image(p, self.size, self.color_space)
+                           for p, _ in scanner.scan(base))
+        if targets:
+            self.original_targets.reset(
+                numpy.stack(targets).astype(numpy.float32))
+        else:
+            # autoencoder convention: target is the input itself
+            self.original_targets.reset(
+                numpy.array(self.original_data.mem, copy=True))

@@ -1,0 +1,72 @@
+"""Interactive and externally-fed loaders.
+
+``InteractiveLoader`` re-designs ``veles/loader/interactive.py:57``: a
+loader whose samples are pushed in from the outside (a shell, a driving
+program, a service endpoint) through :meth:`feed`; serving blocks until
+a sample arrives. The workflow runs in testing (forward-only) mode and
+each fed sample is one test minibatch.
+
+``QueueFedLoader`` is the shared mechanism — it also backs the REST
+inference loader (``veles_tpu/loader/restful.py``) and the socket-fed
+workflow-as-a-service loader (``veles_tpu/zmq_loader.py``), collapsing
+the reference's three bespoke implementations into one.
+"""
+
+import queue
+
+import numpy
+
+from veles_tpu.loader.base import TEST, Loader
+
+
+class QueueFedLoader(Loader):
+    """Serves whatever the outside pushes into an unbounded queue."""
+
+    hide_from_registry = True
+
+    #: sentinel a producer may push to unblock a waiting run loop
+    EOF = object()
+
+    def __init__(self, workflow, **kwargs):
+        self.sample_shape = tuple(kwargs.pop("sample_shape", ()))
+        self.feed_timeout = kwargs.pop("feed_timeout", None)
+        kwargs.setdefault("minibatch_size", 1)
+        super(QueueFedLoader, self).__init__(workflow, **kwargs)
+        self.has_labels = False
+
+    def init_unpickled(self):
+        super(QueueFedLoader, self).init_unpickled()
+        self._queue_ = queue.Queue()
+
+    def feed(self, sample):
+        """Push one sample (numpy array of sample_shape)."""
+        self._queue_.put(numpy.asarray(sample, numpy.float32))
+
+    def finish(self):
+        """Unblock the loop with no more data (ends the workflow)."""
+        self._queue_.put(self.EOF)
+
+    def load_data(self):
+        if not self.sample_shape:
+            raise ValueError("%s needs sample_shape" % self.name)
+        # geometry: an endless test-class stream; one sample per batch
+        self.class_lengths = [1, 0, 0]
+        self.max_minibatch_size = 1
+
+    def create_minibatch_data(self):
+        self.minibatch_data.reset(numpy.zeros(
+            (1,) + self.sample_shape, numpy.float32))
+
+    def fill_minibatch(self):
+        item = self._queue_.get(timeout=self.feed_timeout)
+        if item is self.EOF:
+            self.workflow.stop()
+            return
+        mb = self.minibatch_data.map_invalidate()
+        mb[0] = item.reshape(self.sample_shape)
+        self.minibatch_class = TEST
+        self.minibatch_size = 1
+
+
+class InteractiveLoader(QueueFedLoader):
+    """The user-facing interactive feed (``loader/interactive.py:57``)."""

@@ -1,0 +1,50 @@
+"""Pickled-arrays loader (re-designs ``veles/loader/pickles.py``).
+
+Each class file is a pickle of either ``(data, labels)`` or just
+``data`` (numpy arrays). Staged into the device-resident full batch.
+"""
+
+import pickle
+
+import numpy
+
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+
+class PicklesLoader(FullBatchLoader):
+    """test_path/validation_path/train_path pickles → full batch."""
+
+    def __init__(self, workflow, **kwargs):
+        self.test_path = kwargs.pop("test_path", None)
+        self.validation_path = kwargs.pop("validation_path", None)
+        self.train_path = kwargs.pop("train_path", None)
+        super(PicklesLoader, self).__init__(workflow, **kwargs)
+
+    def _read(self, path):
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if isinstance(blob, tuple) and len(blob) == 2:
+            data, labels = blob
+            return (numpy.asarray(data, numpy.float32),
+                    numpy.asarray(labels, numpy.int32))
+        return numpy.asarray(blob, numpy.float32), None
+
+    def load_dataset(self):
+        data_parts, label_parts = [], []
+        for klass, path in enumerate((self.test_path,
+                                      self.validation_path,
+                                      self.train_path)):
+            if path is None:
+                continue
+            data, labels = self._read(path)
+            self.class_lengths[klass] = len(data)
+            data_parts.append(data)
+            if labels is not None:
+                label_parts.append(labels)
+        if not data_parts:
+            raise ValueError("%s: no pickle paths given" % self.name)
+        self.original_data.reset(numpy.concatenate(data_parts))
+        if label_parts:
+            self.original_labels.reset(numpy.concatenate(label_parts))
+        else:
+            self.has_labels = False

@@ -1,0 +1,17 @@
+"""REST-fed inference loader (re-designs ``veles/loader/restful.py:52``).
+
+Pairs with :class:`veles_tpu.restful_api.RESTfulAPI`: each HTTP request
+pushes its decoded sample here, the workflow's forward pass runs, and
+the API unit reads the output back. Mechanism shared with the
+interactive loader (one queue-fed test minibatch per request).
+"""
+
+from veles_tpu.loader.interactive import QueueFedLoader
+
+
+class RestfulLoader(QueueFedLoader):
+    """One HTTP request = one test minibatch."""
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("minibatch_size", 1)
+        super(RestfulLoader, self).__init__(workflow, **kwargs)

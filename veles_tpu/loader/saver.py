@@ -1,0 +1,139 @@
+"""Minibatch stream recording and replay.
+
+Re-designs ``veles/loader/saver.py:69,182``: ``MinibatchesSaver`` is a
+unit plugged after any loader; every served minibatch (data, labels,
+class, epoch flags) is appended to a compressed stream file. The
+companion ``MinibatchesLoader`` replays that file later as a loader —
+the reference's "preprocessed dataset" workflow: run the expensive
+pipeline once, then train many times from the recording.
+
+The reference framed with snappy; snappy is not in this environment, so
+frames are gzip-compressed pickles with a length prefix (the format is
+self-describing via the header record).
+"""
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy
+
+from veles_tpu.loader.base import Loader
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+
+MAGIC = b"VTPUMB1\x00"
+
+
+def _write_frame(f, obj):
+    blob = gzip.compress(pickle.dumps(obj, protocol=4))
+    f.write(struct.pack("<Q", len(blob)))
+    f.write(blob)
+
+
+def _read_frame(f):
+    header = f.read(8)
+    if len(header) < 8:
+        return None
+    (length,) = struct.unpack("<Q", header)
+    return pickle.loads(gzip.decompress(f.read(length)))
+
+
+class MinibatchesSaver(Unit):
+    """Records every minibatch the linked loader serves."""
+
+    view_group = "SERVICE"
+
+    def __init__(self, workflow, **kwargs):
+        self.file_name = kwargs.pop(
+            "file_name", os.path.join(".", "minibatches.vtpu"))
+        super(MinibatchesSaver, self).__init__(workflow, **kwargs)
+        self.demand("minibatch_data", "minibatch_labels", "minibatch_size",
+                    "minibatch_class", "last_minibatch", "epoch_ended",
+                    "class_lengths", "max_minibatch_size")
+
+    def initialize(self, **kwargs):
+        self._file_ = open(self.file_name, "wb")
+        self._file_.write(MAGIC)
+        _write_frame(self._file_, {
+            "class_lengths": list(self.class_lengths),
+            "max_minibatch_size": int(self.max_minibatch_size),
+        })
+        from veles_tpu.workflow import Workflow
+        if isinstance(self.workflow, Workflow):
+            self.workflow.add_finished_callback(self.close)
+
+    def run(self):
+        data = self.minibatch_data
+        labels = self.minibatch_labels
+        size = int(self.minibatch_size)
+        _write_frame(self._file_, {
+            "data": numpy.asarray(
+                data.map_read() if isinstance(data, Array) else data
+            )[:size].copy(),
+            "labels": None if labels is None else numpy.asarray(
+                labels.map_read() if isinstance(labels, Array) else labels
+            )[:size].copy(),
+            "class": int(self.minibatch_class),
+            "last": bool(self.last_minibatch),
+            "epoch_ended": bool(self.epoch_ended),
+        })
+
+    def close(self):
+        f = getattr(self, "_file_", None)
+        if f is not None and not f.closed:
+            f.close()
+
+
+class MinibatchesLoader(Loader):
+    """Replays a MinibatchesSaver recording as a loader."""
+
+    def __init__(self, workflow, **kwargs):
+        self.file_name = kwargs.pop("file_name", None)
+        super(MinibatchesLoader, self).__init__(workflow, **kwargs)
+
+    def load_data(self):
+        with open(self.file_name, "rb") as f:
+            if f.read(len(MAGIC)) != MAGIC:
+                raise ValueError("%s is not a minibatch recording" %
+                                 self.file_name)
+            header = _read_frame(f)
+            self.class_lengths = list(header["class_lengths"])
+            self.max_minibatch_size = int(header["max_minibatch_size"])
+            # one epoch's worth of frames fully describes the dataset:
+            # stitch them back into per-sample arrays so the standard
+            # shuffling/serving machinery (and the on-device gather
+            # path of subclasses) applies unchanged
+            frames, seen = [], 0
+            while seen < self.total_samples:
+                frame = _read_frame(f)
+                if frame is None:
+                    break
+                frames.append(frame)
+                seen += len(frame["data"])
+        if seen < self.total_samples:
+            raise ValueError(
+                "recording %s holds %d samples, header promises %d" %
+                (self.file_name, seen, self.total_samples))
+        # frames arrive in global serving order: test, validation, train
+        self._data_cache_ = numpy.concatenate([f["data"] for f in frames])
+        labels = [f["labels"] for f in frames]
+        if all(lab is not None for lab in labels):
+            self._labels_cache_ = numpy.concatenate(labels)
+        else:
+            self._labels_cache_ = None
+            self.has_labels = False
+
+    def create_minibatch_data(self):
+        shape = (self.max_minibatch_size,) + self._data_cache_.shape[1:]
+        self.minibatch_data.reset(numpy.zeros(shape, numpy.float32))
+
+    def fill_minibatch(self):
+        indices = self.minibatch_indices.map_read()
+        mb = self.minibatch_data.map_invalidate()
+        count = self.minibatch_size
+        mb[:count] = self._data_cache_[indices[:count]]
+        if self._labels_cache_ is not None:
+            labels = self.minibatch_labels.map_invalidate()
+            labels[:count] = self._labels_cache_[indices[:count]]

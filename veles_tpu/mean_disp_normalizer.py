@@ -1,0 +1,54 @@
+"""MeanDispNormalizer unit (re-designs ``veles/mean_disp_normalizer.py``).
+
+On-device ``output = (input - mean) * rdisp`` with per-feature mean and
+reciprocal dispersion, the reference's kernel pair
+``ocl|cuda/mean_disp_normalizer.*`` mapped onto one fused VPU pass
+(:func:`veles_tpu.ops.normalize.mean_disp_normalize`).
+"""
+
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+from veles_tpu.ops.normalize import mean_disp_normalize
+
+
+class MeanDispNormalizer(AcceleratedUnit):
+    """Demands input/mean/rdisp; produces normalized float32 output."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MeanDispNormalizer, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.mean = None
+        self.rdisp = None
+        self.output = Array()
+        self.demand("input", "mean", "rdisp")
+
+    def _mem(self, attr):
+        value = getattr(self, attr)
+        return value.mem if isinstance(value, Array) else value
+
+    def _dev(self, attr):
+        value = getattr(self, attr)
+        if isinstance(value, Array):
+            value.unmap()
+            return value.devmem
+        return value
+
+    def initialize(self, device=None, **kwargs):
+        super(MeanDispNormalizer, self).initialize(device=device, **kwargs)
+        self.output.reset(numpy.zeros(self._mem("input").shape,
+                                      numpy.float32))
+        self.init_vectors(self.output, *(getattr(self, a) for a in
+                                         ("input", "mean", "rdisp")
+                                         if isinstance(getattr(self, a),
+                                                       Array)))
+
+    def jax_run(self):
+        self.output.assign_devmem(mean_disp_normalize(
+            self._dev("input"), self._dev("mean"), self._dev("rdisp")))
+
+    def numpy_run(self):
+        out = self.output.map_invalidate()
+        x = numpy.asarray(self._mem("input"), numpy.float32)
+        out[...] = (x - self._mem("mean")) * self._mem("rdisp")

@@ -119,11 +119,25 @@ class DecisionBase(Unit, IResultProvider):
         klass = data["klass"]
         bucket[klass]["samples"] += data["samples"]
         bucket[klass]["metric"] += data["metric"]
-        if data["last"]:
+        # Close on SAMPLE COUNTS, not on the last/epoch_ended flags: with
+        # several slaves the flagged minibatch's update can arrive while
+        # sibling updates of the same epoch are still in flight, and a
+        # flag-triggered close would finalize an incomplete bucket.
+        # Every epoch serves exactly sum(class_lengths) samples (requeues
+        # are exact replays), so counts are a reliable completion signal.
+        if bucket[klass]["samples"] == self.class_lengths[klass]:
             self._on_class_finished(klass, epoch=epoch, stats_set=bucket)
-        if data["epoch_ended"]:
+        if sum(b["samples"] for b in bucket) == sum(self.class_lengths):
             self._on_epoch_finished(epoch=epoch, stats_set=bucket)
             buckets.pop(epoch, None)
+        # bound run-ahead: with asymmetric slave speeds the loader would
+        # otherwise serve arbitrarily many epochs past the oldest still
+        # open one, training epochs the stop decision may cancel.
+        # Withholding data (has_data_for_slave=False) idles job requests
+        # until the laggard's updates close the old epoch.
+        min_open = min(buckets) if buckets else None
+        self.has_data_for_slave = (
+            min_open is None or self.epoch_number - min_open <= 1)
         if bool(self.complete) and self.is_master:
             # the master's workflow never runs: propagate the stop
             # decision straight to the job source (NoMoreJobs)
@@ -152,7 +166,12 @@ class DecisionBase(Unit, IResultProvider):
         summary = {CLASS_NAMES[i]: dict(stats_set[i])
                    for i in range(3) if self.class_lengths[i]}
         summary["epoch"] = epoch
-        self.epoch_history.append(summary)
+        # insertion sort by epoch: out-of-order closes (async slaves)
+        # must not scramble the history
+        pos = len(self.epoch_history)
+        while pos and self.epoch_history[pos - 1]["epoch"] > epoch:
+            pos -= 1
+        self.epoch_history.insert(pos, summary)
         self.info("epoch %d: %s", epoch, "  ".join(
             "%s %s=%.4f" % (CLASS_NAMES[i], self.METRIC_NAME,
                             stats_set[i].get("normalized", numpy.nan))

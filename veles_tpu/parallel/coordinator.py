@@ -75,6 +75,9 @@ class SlaveDescription(object):
         self.jobs_done = 0
         self.last_seen = time.time()
         self.current_job = None
+        # True while result_sink is merging this slave's update: the
+        # reaper must not drop/requeue mid-merge (double training)
+        self.applying = False
 
 
 class CoordinatorServer(Logger):
@@ -162,6 +165,10 @@ class CoordinatorServer(Logger):
         now = time.time()
         timeout = self._adaptive_timeout()
         for sid, slave in list(self.slaves.items()):
+            if slave.applying:
+                # its result already arrived and is being merged — a
+                # drop now would requeue a minibatch that IS trained
+                continue
             dead = now - slave.last_seen > self.heartbeat_timeout
             overrun = (timeout is not None and slave.current_job and
                        now - slave.current_job[1] > timeout)
@@ -277,6 +284,7 @@ class CoordinatorServer(Logger):
                 if self.result_sink is None:
                     self.results.append(msg.get("data"))
                     return {"ok": True}, False
+                slave.applying = True
                 action = "sink"
             elif cmd == "heartbeat":
                 slave.power = msg.get("power", slave.power)
@@ -292,6 +300,12 @@ class CoordinatorServer(Logger):
                 self.no_more_jobs = True
             with self._lock:
                 if sid not in self.slaves:
+                    # the reaper dropped this slave while the job was
+                    # being generated: the workflow registered the
+                    # payload as pending for it — run the drop path once
+                    # more so that registration is requeued, not lost
+                    if payload is not None and self.on_drop is not None:
+                        self.on_drop(slave)
                     return {"error": "dropped"}, True
                 if payload is not None:
                     slave.current_job = (payload, time.time())
@@ -300,8 +314,17 @@ class CoordinatorServer(Logger):
                 slave.state = "IDLE"
                 return {"job": None, "done": self.no_more_jobs}, False
         # action == "sink"
-        self.result_sink(msg.get("data"), slave)
+        try:
+            self.result_sink(msg.get("data"), slave)
+        finally:
+            with self._lock:
+                slave.applying = False
         return {"ok": True}, False
+
+    def snapshot_slaves(self):
+        """Consistent copy of the slave registry for outside readers."""
+        with self._lock:
+            return list(self.slaves.values())
 
     def _serve_heartbeats(self, proto, sid):
         proto.send({"ok": sid in self.slaves})

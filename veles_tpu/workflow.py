@@ -217,16 +217,21 @@ class Workflow(Container):
             self[name].apply_data_from_master(payload)
 
     def generate_data_for_slave(self, slave=None):
-        """Collect one job: per-unit payloads (``workflow.py:476-511``)."""
+        """Collect one job: per-unit payloads (``workflow.py:476-511``).
+
+        Returns None (slave idles briefly) when any unit withholds data
+        via ``has_data_for_slave`` — e.g. the decision bounding epoch
+        run-ahead. Non-blocking by design: the thread asking for this
+        job may be the only one that could otherwise apply the update
+        that would unblock it.
+        """
         if bool(self.stopped):
             raise NoMoreJobs()
-        job = []
-        for unit in self._distributed_units():
-            if not unit.has_data_for_slave:
-                unit.wait_for_data_for_slave()
-            job.append((unit.name, unit.generate_data_for_slave_locked(
-                slave)))
-        return job
+        units = self._distributed_units()
+        if not all(u.has_data_for_slave for u in units):
+            return None
+        return [(u.name, u.generate_data_for_slave_locked(slave))
+                for u in units]
 
     def apply_data_from_master(self, job):
         for name, payload in job:

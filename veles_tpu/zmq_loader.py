@@ -1,0 +1,70 @@
+"""Workflow-as-a-service feed (re-designs ``veles/zmq_loader.py:74``).
+
+The reference exposed a ZeroMQ ROUTER endpoint external producers push
+work items into; the workflow consumes them as minibatches. Here the
+wire is a stdlib JSON-lines TCP socket (the same framing as the
+coordinator control plane) and the consuming side is the shared
+queue-fed loader. Producers connect, send one JSON object per line
+(``{"data": [...]}``) and receive ``{"ok": true}`` acks; ``{"cmd":
+"finish"}`` ends the stream and thereby the workflow.
+"""
+
+import json
+import socket
+import threading
+
+import numpy
+
+from veles_tpu.loader.interactive import QueueFedLoader
+
+
+class SocketFedLoader(QueueFedLoader):
+    """Queue-fed loader with a TCP JSON-lines producer endpoint."""
+
+    def __init__(self, workflow, **kwargs):
+        self.endpoint = kwargs.pop("endpoint", ("127.0.0.1", 0))
+        super(SocketFedLoader, self).__init__(workflow, **kwargs)
+
+    def load_data(self):
+        super(SocketFedLoader, self).load_data()
+        self._listener_ = socket.create_server(tuple(self.endpoint))
+        self.address = self._listener_.getsockname()
+        self._accepting_ = True
+        thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                  name="%s-accept" % self.name)
+        thread.start()
+        self.info("feed endpoint on %s:%d", *self.address)
+
+    def _accept_loop(self):
+        while self._accepting_:
+            try:
+                sock, _ = self._listener_.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(sock,),
+                             daemon=True).start()
+
+    def _serve(self, sock):
+        with sock, sock.makefile("rwb") as f:
+            for line in f:
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    f.write(b'{"error": "bad json"}\n')
+                    f.flush()
+                    continue
+                if msg.get("cmd") == "finish":
+                    self.finish()
+                    f.write(b'{"ok": true, "finished": true}\n')
+                    f.flush()
+                    return
+                self.feed(numpy.asarray(msg["data"], numpy.float32))
+                f.write(b'{"ok": true}\n')
+                f.flush()
+
+    def stop_serving(self):
+        self._accepting_ = False
+        try:
+            self._listener_.close()
+        except OSError:
+            pass
