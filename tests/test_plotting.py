@@ -1,0 +1,157 @@
+"""Plotting layer: server PUB/SUB round-trip + client rendering.
+
+Mirrors the reference's in-process service-test pattern (SURVEY.md §4):
+real sockets on localhost, no external processes.
+"""
+
+import os
+import pickle
+import zlib
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.graphics_client import GraphicsClient
+from veles_tpu.graphics_server import TOPIC, TOPIC_END, GraphicsServer
+from veles_tpu.plotting_units import (AccumulatingPlotter, ImagePlotter,
+                                      MatrixPlotter, SimpleHistogram)
+
+zmq = pytest.importorskip("zmq")
+
+
+@pytest.fixture
+def server():
+    srv = GraphicsServer()
+    yield srv
+    srv.stop()
+
+
+def _subscribe(srv):
+    sock = zmq.Context.instance().socket(zmq.SUB)
+    sock.connect(srv.endpoints["tcp"])
+    sock.setsockopt(zmq.SUBSCRIBE, b"")
+    # PUB/SUB needs a beat to join; poll in the caller covers it.
+    return sock
+
+
+def test_pub_roundtrip_strips_graph(server):
+    sock = _subscribe(server)
+    wf = DummyWorkflow()
+    plotter = AccumulatingPlotter(wf, name="err")
+    plotter.input = 0.25
+    import time
+    deadline = time.time() + 5
+    got = None
+    while time.time() < deadline:
+        plotter.run()
+        if sock.poll(200, zmq.POLLIN):
+            got = sock.recv_multipart()
+            break
+    assert got is not None, "no snapshot arrived"
+    topic, payload = got
+    assert topic == TOPIC
+    clone = pickle.loads(zlib.decompress(payload))
+    assert clone.values and clone.values[-1] == 0.25
+    assert clone._workflow is None  # stripped: no graph dragged along
+    sock.close(linger=0)
+
+
+def test_end_topic_on_stop():
+    srv = GraphicsServer()
+    sock = _subscribe(srv)
+    import time
+    time.sleep(0.2)  # let SUB join before the single end message
+    srv.stop()
+    assert sock.poll(2000, zmq.POLLIN)
+    topic, _ = sock.recv_multipart()
+    assert topic == TOPIC_END
+    sock.close(linger=0)
+
+
+def test_plotter_skipped_on_slave(server):
+    wf = DummyWorkflow()
+    wf.workflow._is_slave = True  # DummyLauncher honors this
+    plotter = AccumulatingPlotter(wf, name="err")
+    plotter.input = 1.0
+    if plotter.enabled:  # only meaningful when launcher reports slave
+        pytest.skip("dummy launcher does not model slave mode")
+    plotter.run()
+    assert plotter.values == []
+
+
+@pytest.mark.parametrize("make", [
+    lambda wf: _with_input(AccumulatingPlotter(wf, name="acc"), 0.5),
+    lambda wf: _with_input(MatrixPlotter(wf, name="conf"),
+                           numpy.arange(9).reshape(3, 3)),
+    lambda wf: _with_input(SimpleHistogram(wf, name="hist"),
+                           numpy.random.RandomState(0).randn(100)),
+    lambda wf: _with_input(ImagePlotter(wf, name="imgs"),
+                           numpy.random.RandomState(0).randn(5, 784)),
+])
+def test_redraw_renders(tmp_path, make):
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as pp
+    wf = DummyWorkflow()
+    plotter = make(wf)
+    plotter.fill()
+    figure = pp.figure()
+    plotter.redraw(figure)
+    out = tmp_path / "plot.png"
+    figure.savefig(str(out))
+    pp.close(figure)
+    assert out.stat().st_size > 0
+
+
+def _with_input(plotter, value):
+    plotter.input = value
+    return plotter
+
+
+def test_client_renders_png(tmp_path, server):
+    client = GraphicsClient(server.endpoints["tcp"], mode="png",
+                            out=str(tmp_path))
+    wf = DummyWorkflow()
+    plotter = AccumulatingPlotter(wf, name="val err")
+    plotter.input = 0.1
+    import time
+    deadline = time.time() + 5
+    rendered = False
+    while time.time() < deadline:
+        plotter.run()
+        if client._socket_.poll(200, zmq.POLLIN):
+            client.serve_one()
+            rendered = True
+            break
+    client.close()
+    assert rendered
+    files = os.listdir(str(tmp_path))
+    assert any(f.endswith(".png") for f in files)
+
+
+def test_mnist_workflow_with_plotters(server):
+    """Full training run with the standard plot set wired in: plots
+    stream out per epoch and carry the real metric history."""
+    import time
+    from test_mnist_e2e import build
+    from veles_tpu.backends import Device
+
+    sock = _subscribe(server)
+    time.sleep(0.2)
+    wf = build(Device(backend="cpu"), max_epochs=2)
+    wf.add_plotters()
+    assert len(wf.plotters) == 3
+    wf.run()
+    snapshots = []
+    while sock.poll(300, zmq.POLLIN):
+        topic, payload = sock.recv_multipart()
+        if topic == TOPIC:
+            snapshots.append(pickle.loads(zlib.decompress(payload)))
+    sock.close(linger=0)
+    curves = [s for s in snapshots if s.name == "validation n_err_pt"]
+    assert curves, [s.name for s in snapshots]
+    assert len(curves[-1].values) == 2  # one point per epoch
+    confusion = [s for s in snapshots if s.name == "confusion"]
+    assert confusion and confusion[-1].matrix.shape[0] == \
+        confusion[-1].matrix.shape[1]

@@ -1,0 +1,91 @@
+"""Rendering client for the graphics server.
+
+Re-designs ``veles/graphics_client.py:68-257``: a separate process
+subscribes to the PUB endpoint, unpickles plotter snapshots and renders
+them with matplotlib. Modes: ``show`` (interactive window), ``png`` /
+``pdf`` (one file per plotter name in ``--out``, overwritten on each
+snapshot so the directory always holds the latest state).
+"""
+
+import argparse
+import os
+import pickle
+import zlib
+
+from veles_tpu.graphics_server import TOPIC, TOPIC_END
+from veles_tpu.logger import Logger
+
+
+class GraphicsClient(Logger):
+    """SUB-socket consumer rendering plotter snapshots."""
+
+    def __init__(self, endpoint, mode="png", out=None, **kwargs):
+        super(GraphicsClient, self).__init__(**kwargs)
+        self.endpoint = endpoint
+        self.mode = mode
+        self.out = out or os.getcwd()
+        if mode != "show":
+            import matplotlib
+            matplotlib.use("Agg")
+        import zmq
+        self._context_ = zmq.Context.instance()
+        self._socket_ = self._context_.socket(zmq.SUB)
+        self._socket_.connect(endpoint)
+        self._socket_.setsockopt(zmq.SUBSCRIBE, b"")
+
+    def run(self):
+        """Receive and render until the ``end`` topic arrives."""
+        while True:
+            if not self.serve_one():
+                break
+
+    def serve_one(self, timeout=None):
+        """Render one snapshot; False when the stream ended."""
+        import zmq
+        if timeout is not None:
+            if not self._socket_.poll(int(timeout * 1000), zmq.POLLIN):
+                return True
+        topic, payload = self._socket_.recv_multipart()
+        if topic == TOPIC_END:
+            return False
+        plotter = pickle.loads(zlib.decompress(payload))
+        self.render(plotter)
+        return True
+
+    def render(self, plotter):
+        import matplotlib.pyplot as pp
+        figure = pp.figure(plotter.name)
+        figure.clf()
+        try:
+            plotter.redraw(figure)
+        except Exception as exc:  # a bad plot must not kill the client
+            self.warning("redraw of %s failed: %s", plotter.name, exc)
+            pp.close(figure)
+            return
+        if self.mode == "show":
+            figure.show()
+            pp.pause(0.001)
+        else:
+            name = "".join(c if c.isalnum() or c in "-_" else "_"
+                           for c in plotter.name)
+            path = os.path.join(self.out, "%s.%s" % (name, self.mode))
+            figure.savefig(path)
+            pp.close(figure)
+        return figure
+
+    def close(self):
+        self._socket_.close(linger=0)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--endpoint", required=True)
+    parser.add_argument("--mode", default="png",
+                        choices=("show", "png", "pdf"))
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args(argv)
+    GraphicsClient(args.endpoint, mode=args.mode, out=args.out).run()
+
+
+if __name__ == "__main__":
+    main()

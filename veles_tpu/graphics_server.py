@@ -1,0 +1,93 @@
+"""ZeroMQ PUB fan-out of plot snapshots.
+
+Re-designs ``veles/graphics_server.py:65-143``: plotter units pickle
+themselves (stripped) and the server publishes them on a PUB socket;
+any number of rendering clients (:mod:`veles_tpu.graphics_client`)
+subscribe from the same or another machine. Endpoints: a random-port
+TCP bind (always) plus an ipc:// path when the platform supports it —
+the reference's epgm multicast leg is dropped (DCN/ICI carry no plot
+traffic on TPU pods; TCP covers the cross-host case).
+
+The payload framing is ``[topic, zlib(pickle(plotter))]`` with topic
+``b"graphics"`` for snapshots and ``b"end"`` for shutdown — the
+reference's snappy codec is replaced by stdlib zlib so the client has
+zero non-baked dependencies.
+"""
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import threading
+import zlib
+
+from veles_tpu.logger import Logger
+
+TOPIC = b"graphics"
+TOPIC_END = b"end"
+
+
+class GraphicsServer(Logger):
+    """Publishes pickled plotter snapshots over ZeroMQ PUB.
+
+    The most recently constructed server is reachable as
+    ``GraphicsServer.current`` — plotter units use it implicitly, the
+    way reference plotters reached the process-wide server singleton
+    (``veles/graphics_server.py:153-163``).
+    """
+
+    current = None
+
+    def __init__(self, **kwargs):
+        super(GraphicsServer, self).__init__(**kwargs)
+        import zmq
+        self._context_ = zmq.Context.instance()
+        self._socket_ = self._context_.socket(zmq.PUB)
+        self._lock_ = threading.Lock()
+        port = self._socket_.bind_to_random_port("tcp://127.0.0.1")
+        self.endpoints = {"tcp": "tcp://127.0.0.1:%d" % port}
+        if hasattr(os, "fork"):  # ipc transport exists on POSIX only
+            path = os.path.join(tempfile.mkdtemp(prefix="veles-graphics-"),
+                                "plots.ipc")
+            self._socket_.bind("ipc://" + path)
+            self.endpoints["ipc"] = "ipc://" + path
+        self.stopped = False
+        GraphicsServer.current = self
+        self.debug("graphics server on %s", self.endpoints["tcp"])
+
+    def enqueue(self, plotter):
+        """Pickle (stripped) and publish one plotter snapshot."""
+        if self.stopped:
+            return
+        plotter.stripped_pickle = True
+        try:
+            payload = zlib.compress(pickle.dumps(plotter, protocol=4), 1)
+        finally:
+            plotter.stripped_pickle = False
+        with self._lock_:
+            self._socket_.send_multipart([TOPIC, payload])
+
+    def launch_client(self, mode="png", out=None):
+        """Spawn a rendering client subprocess against our endpoint."""
+        argv = [sys.executable, "-m", "veles_tpu.graphics_client",
+                "--endpoint", self.endpoints["tcp"], "--mode", mode]
+        if out:
+            argv += ["--out", out]
+        env = dict(os.environ)
+        package_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env["PYTHONPATH"] = os.pathsep.join(
+            [package_root] + env.get("PYTHONPATH", "").split(os.pathsep))
+        env.setdefault("JAX_PLATFORMS", "cpu")  # renderer needs no chip
+        return subprocess.Popen(argv, env=env)
+
+    def stop(self):
+        if self.stopped:
+            return
+        self.stopped = True
+        with self._lock_:
+            self._socket_.send_multipart([TOPIC_END, b""])
+            self._socket_.close(linger=200)
+        if GraphicsServer.current is self:
+            GraphicsServer.current = None

@@ -1,0 +1,67 @@
+"""Plotter unit base.
+
+Re-designs ``veles/plotter.py:48-166``: a plotter is an ordinary unit
+in the control-flow graph whose ``run()`` captures plot data host-side
+and ships a stripped pickle of itself to the graphics server; the
+actual matplotlib rendering happens in the client process
+(:mod:`veles_tpu.graphics_client`), never on the training path. On
+slaves plotters are skipped entirely — plots describe canonical
+(master/standalone) state.
+
+Subclasses implement ``fill()`` (grab data from linked attributes —
+this is the only part that touches live arrays, so it forces host sync
+exactly once per plot) and ``redraw(figure)`` (pure matplotlib over the
+captured data).
+"""
+
+from veles_tpu.config import root
+from veles_tpu.units import Unit
+
+
+class Plotter(Unit):
+    """Base unit for all plotters. See module docstring."""
+
+    hide_from_registry = True
+    view_group = "PLOTTER"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("view_group", "PLOTTER")
+        super(Plotter, self).__init__(workflow, **kwargs)
+        self.clear_plot = kwargs.get("clear_plot", False)
+        self.redraw_plot = kwargs.get("redraw_plot", True)
+        self.last_figure_ = None
+
+    @property
+    def enabled(self):
+        if self.is_slave:
+            return False
+        # Headless runs disable plotting by default (config.py), but a
+        # live graphics server means someone subscribed file/remote
+        # renderers — that overrides the no-DISPLAY heuristic.
+        if self._find_server() is not None:
+            return True
+        return not root.common.disable.get("plotting", False)
+
+    def initialize(self, **kwargs):
+        pass
+
+    def run(self):
+        if not self.enabled:
+            return
+        self.fill()
+        server = self._find_server()
+        if server is not None:
+            server.enqueue(self)
+
+    def _find_server(self):
+        from veles_tpu.graphics_server import GraphicsServer
+        launcher = self.launcher
+        server = getattr(launcher, "_graphics_server", None)
+        return server if server is not None else GraphicsServer.current
+
+    def fill(self):
+        """Capture plot data from linked attributes into plain fields."""
+
+    def redraw(self, figure):
+        """Render the captured data onto ``figure`` (client side)."""
+        raise NotImplementedError

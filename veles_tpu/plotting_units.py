@@ -1,0 +1,181 @@
+"""Concrete plotter units.
+
+Re-designs ``veles/plotting_units.py``: the accumulating metric curve,
+the confusion-matrix plot, histograms and image mosaics — the set the
+reference samples wire into every workflow. Each captures data in
+``fill()`` (host-side, one sync point) and renders in ``redraw()``
+inside the graphics client.
+"""
+
+import numpy
+
+from veles_tpu.plotter import Plotter
+
+
+def _to_host(value):
+    """Any array-ish (jax.Array, veles Array, number) → numpy/float."""
+    devmem = getattr(value, "devmem", None)
+    if devmem is not None:
+        value = devmem
+    return numpy.asarray(value)
+
+
+class AccumulatingPlotter(Plotter):
+    """Curve of a scalar metric over time (AccumulatingPlotter).
+
+    ``input`` is a linked attribute; ``input_field`` optionally selects
+    a key/index inside it. Appends one point per run.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(AccumulatingPlotter, self).__init__(workflow, **kwargs)
+        self.input_field = kwargs.get("input_field", None)
+        self.label = kwargs.get("label", self.name)
+        self.plot_style = kwargs.get("plot_style", "-")
+        self.values = []
+        self.demand("input")
+
+    def fill(self):
+        value = self.input
+        if self.input_field is not None:
+            try:
+                value = value[self.input_field]
+            except TypeError:
+                value = getattr(value, self.input_field)
+        if self.clear_plot:
+            del self.values[:]
+        self.values.append(float(_to_host(value)))
+
+    def redraw(self, figure):
+        axes = figure.add_subplot(111)
+        axes.plot(self.values, self.plot_style, label=self.label)
+        axes.set_xlabel("updates")
+        axes.set_ylabel(self.label)
+        axes.grid(True)
+        if len(self.values) > 1:
+            axes.legend(loc="best")
+        figure.suptitle(self.name)
+
+
+class EpochMetricPlotter(AccumulatingPlotter):
+    """Per-epoch normalized metric curve from a Decision unit.
+
+    ``input`` links to the decision's ``epoch_history``; ``klass``
+    selects which sample-class curve to plot ("train"/"validation"/
+    "test").
+    """
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("input_field", None)
+        super(EpochMetricPlotter, self).__init__(workflow, **kwargs)
+        self.klass = kwargs.get("klass", "validation")
+        self.label = kwargs.get("label", self.klass)
+
+    def fill(self):
+        history = self.input
+        if not history:
+            return
+        stats = history[-1].get(self.klass)
+        if stats and "normalized" in stats:
+            self.values.append(float(stats["normalized"]))
+
+
+class MatrixPlotter(Plotter):
+    """Confusion-matrix heatmap with per-cell counts (MatrixPlotter)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MatrixPlotter, self).__init__(workflow, **kwargs)
+        self.matrix = None
+        self.reversed_labels_mapping = kwargs.get(
+            "reversed_labels_mapping", None)
+        self.demand("input")
+
+    def fill(self):
+        matrix = _to_host(self.input).copy()
+        if matrix.ndim == 1:  # evaluator ships it flattened
+            side = int(round(numpy.sqrt(matrix.size)))
+            matrix = matrix.reshape(side, side)
+        self.matrix = matrix
+
+    def redraw(self, figure):
+        axes = figure.add_subplot(111)
+        num = self.matrix.shape[0]
+        axes.imshow(self.matrix, interpolation="nearest", cmap="Blues")
+        threshold = self.matrix.max() / 2.0 if self.matrix.size else 0
+        for (row, col), count in numpy.ndenumerate(self.matrix):
+            axes.text(col, row, "%d" % count, ha="center", va="center",
+                      color="white" if count > threshold else "black")
+        labels = (self.reversed_labels_mapping or
+                  [str(i) for i in range(num)])
+        axes.set_xticks(range(num))
+        axes.set_yticks(range(num))
+        axes.set_xticklabels(labels)
+        axes.set_yticklabels(labels)
+        axes.set_xlabel("predicted")
+        axes.set_ylabel("target")
+        figure.suptitle(self.name)
+
+
+class SimpleHistogram(Plotter):
+    """Histogram of a flat array (Histogram / MultiHistogram family)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(SimpleHistogram, self).__init__(workflow, **kwargs)
+        self.bins = kwargs.get("bins", 50)
+        self.data = None
+        self.demand("input")
+
+    def fill(self):
+        self.data = _to_host(self.input).ravel().copy()
+
+    def redraw(self, figure):
+        axes = figure.add_subplot(111)
+        axes.hist(self.data, bins=self.bins)
+        axes.grid(True)
+        figure.suptitle(self.name)
+
+
+class ImagePlotter(Plotter):
+    """Mosaic of 2D slices (ImagePlotter / Weights2D).
+
+    ``input`` is an array whose first axis indexes samples/filters; up
+    to ``limit`` slices are tiled into a square grid.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(ImagePlotter, self).__init__(workflow, **kwargs)
+        self.limit = kwargs.get("limit", 16)
+        self.color = kwargs.get("color", False)
+        self.images = None
+        self.demand("input")
+
+    def fill(self):
+        data = _to_host(self.input)
+        if data.ndim == 1:
+            data = data[numpy.newaxis]
+        count = min(self.limit, data.shape[0])
+        images = []
+        for i in range(count):
+            img = data[i]
+            if img.ndim == 1:  # flat sample → squarest 2D reshape
+                side = int(numpy.sqrt(img.size))
+                while img.size % side:
+                    side -= 1
+                img = img.reshape(side, img.size // side)
+            images.append(numpy.array(img, dtype=numpy.float32))
+        self.images = images
+
+    def redraw(self, figure):
+        count = len(self.images)
+        side = int(numpy.ceil(numpy.sqrt(count)))
+        for i, img in enumerate(self.images):
+            axes = figure.add_subplot(side, side, i + 1)
+            if img.ndim == 3 and self.color:
+                lo, hi = img.min(), img.max()
+                axes.imshow((img - lo) / max(hi - lo, 1e-30))
+            else:
+                if img.ndim == 3:
+                    img = img.mean(axis=-1)
+                axes.imshow(img, interpolation="nearest", cmap="gray")
+            axes.axis("off")
+        figure.suptitle(self.name)

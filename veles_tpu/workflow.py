@@ -313,6 +313,43 @@ class Workflow(Container):
         lines.append("}")
         return "\n".join(lines)
 
+    def add_plotters(self, klasses=("train", "validation"),
+                     confusion=True):
+        """Wire the standard plot set the reference samples carry.
+
+        Needs ``self.decision`` / ``self.loader`` (and optionally
+        ``self.evaluator`` for the confusion heatmap), which every
+        training workflow here exposes. One epoch-metric curve per
+        sample class, plotters run after the decision and only at
+        epoch boundaries; they never sit on the training path.
+        """
+        from veles_tpu.plotting_units import (EpochMetricPlotter,
+                                              MatrixPlotter)
+        self.plotters = []
+        prev = self.decision
+        for klass in klasses:
+            plotter = EpochMetricPlotter(
+                self, name="%s %s" % (klass, self.decision.METRIC_NAME),
+                klass=klass)
+            plotter.link_from(prev)
+            plotter.link_attrs(self.decision, ("input", "epoch_history"))
+            plotter.gate_skip = ~self.loader.epoch_ended
+            self.plotters.append(plotter)
+            prev = plotter
+        evaluator = getattr(self, "evaluator", None)
+        if confusion and evaluator is not None and \
+                hasattr(evaluator, "confusion_matrix"):
+            plotter = MatrixPlotter(self, name="confusion")
+            plotter.link_from(prev)
+            plotter.link_attrs(evaluator, ("input", "confusion_matrix"))
+            plotter.gate_skip = ~self.loader.epoch_ended
+            self.plotters.append(plotter)
+        # plotters may be wired onto an already-initialized workflow
+        for plotter in self.plotters:
+            if not plotter.is_initialized:
+                plotter._initialize_wrapped()
+        return self.plotters
+
     def package_export(self, path, precision="float32"):
         """Export an inference package (see :mod:`veles_tpu.export`)."""
         try:
