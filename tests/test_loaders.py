@@ -268,3 +268,77 @@ def test_avatar_mirrors_attrs():
     avatar.run()
     assert avatar.count == 9
     assert numpy.allclose(avatar.values.mem, 2.0)
+
+
+def test_hdf5_partial_labels_rejected(tmp_path):
+    import h5py
+    from veles_tpu.loader.hdf5 import HDF5Loader
+    rng = numpy.random.RandomState(0)
+    with h5py.File(tmp_path / "train.h5", "w") as f:
+        f["data"] = rng.rand(6, 5).astype(numpy.float32)
+        f["labels"] = rng.randint(0, 3, 6).astype(numpy.int32)
+    with h5py.File(tmp_path / "valid.h5", "w") as f:
+        f["data"] = rng.rand(4, 5).astype(numpy.float32)  # no labels
+    loader = HDF5Loader(DummyWorkflow(),
+                        train_path=str(tmp_path / "train.h5"),
+                        validation_path=str(tmp_path / "valid.h5"),
+                        minibatch_size=2)
+    with pytest.raises(ValueError, match="all or none"):
+        _init_loader(loader)
+
+
+def test_pickles_partial_labels_rejected(tmp_path):
+    from veles_tpu.loader.pickles import PicklesLoader
+    rng = numpy.random.RandomState(0)
+    with open(tmp_path / "train.pickle", "wb") as f:
+        pickle.dump((rng.rand(8, 4).astype(numpy.float32),
+                     rng.randint(0, 2, 8).astype(numpy.int32)), f)
+    with open(tmp_path / "valid.pickle", "wb") as f:
+        pickle.dump(rng.rand(4, 4).astype(numpy.float32), f)
+    loader = PicklesLoader(DummyWorkflow(),
+                           train_path=str(tmp_path / "train.pickle"),
+                           validation_path=str(tmp_path / "valid.pickle"),
+                           minibatch_size=2)
+    with pytest.raises(ValueError, match="all or none"):
+        _init_loader(loader)
+
+
+def test_socket_fed_loader_bad_items_get_error_replies():
+    from veles_tpu.zmq_loader import SocketFedLoader
+    loader = SocketFedLoader(DummyWorkflow(), sample_shape=(2,))
+    _init_loader(loader)
+    try:
+        with socket.create_connection(loader.address, timeout=5) as sock:
+            f = sock.makefile("rwb")
+            for bad in (b'{"cmd": "ping"}', b'{"data": [[1], [2, 3]]}',
+                        b'not json at all'):
+                f.write(bad + b"\n")
+                f.flush()
+                reply = json.loads(f.readline())
+                assert "error" in reply, (bad, reply)
+            # the connection survives the bad items
+            f.write(json.dumps({"data": [7.0, 8.0]}).encode() + b"\n")
+            f.flush()
+            assert json.loads(f.readline())["ok"]
+        loader.run()
+        assert numpy.allclose(loader.minibatch_data.mem[0], [7, 8])
+    finally:
+        loader.stop_serving()
+
+
+def test_decision_drop_slave_reopens_runahead_gate():
+    """A dead slave's requeued minibatches must be servable: the
+    run-ahead throttle reopens on drop (deadlock regression)."""
+    from veles_tpu.nn.decision import DecisionGD
+    wf = DummyWorkflow()
+    decision = DecisionGD(wf)
+    decision.class_lengths = [0, 10, 30]
+    decision.epoch_number = 3
+    # an old epoch is still open and the loader ran far ahead
+    decision._epoch_buckets_ = {
+        1: [dict(samples=0, metric=0.0) for _ in range(3)]}
+    decision.apply_data_from_slave(
+        {"epoch": 1, "klass": 2, "samples": 5, "metric": 1.0})
+    assert not decision.has_data_for_slave
+    decision.drop_slave("s1")
+    assert decision.has_data_for_slave

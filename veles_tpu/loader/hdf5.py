@@ -55,6 +55,12 @@ class HDF5Loader(FullBatchLoader):
         if not data_parts:
             raise ValueError("%s: no HDF5 paths given" % self.name)
         self.original_data.reset(numpy.concatenate(data_parts))
+        if label_parts and len(label_parts) != len(data_parts):
+            # labels gather by global sample index: a partial label set
+            # would silently misalign classes against samples
+            raise ValueError(
+                "%s: %d of %d class files carry labels — need all or "
+                "none" % (self.name, len(label_parts), len(data_parts)))
         if label_parts:
             self.original_labels.reset(numpy.concatenate(label_parts))
         else:

@@ -60,6 +60,10 @@ class QueueFedLoader(Loader):
     def fill_minibatch(self):
         item = self._queue_.get(timeout=self.feed_timeout)
         if item is self.EOF:
+            # stop() aborts in-flight signals, so nothing downstream
+            # runs this iteration; zeroing the size is defense in depth
+            # against a consumer inspecting loader state post-run
+            self.minibatch_size = 0
             self.workflow.stop()
             return
         mb = self.minibatch_data.map_invalidate()

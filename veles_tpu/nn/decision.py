@@ -86,6 +86,14 @@ class DecisionBase(Unit, IResultProvider):
 
     # -- distribution: metrics ride slave→master, master decides stop ------
 
+    def drop_slave(self, slave=None):
+        # A dead slave may have held the very minibatches that keep the
+        # oldest epoch open; the loader is about to requeue them, and
+        # serving the replays requires job generation — so the run-ahead
+        # throttle must reopen here. It re-closes on the next update if
+        # the loader is still too far ahead.
+        self.has_data_for_slave = True
+
     def generate_data_for_slave(self, slave=None):
         # non-None payload so the slave's apply_data_from_master runs:
         # it must re-arm the loop gate the previous job closed

@@ -45,22 +45,30 @@ class SocketFedLoader(QueueFedLoader):
                              daemon=True).start()
 
     def _serve(self, sock):
-        with sock, sock.makefile("rwb") as f:
-            for line in f:
+        from veles_tpu.parallel.coordinator import Protocol
+        proto = Protocol(sock)
+        with sock:
+            while True:
                 try:
-                    msg = json.loads(line)
-                except json.JSONDecodeError:
-                    f.write(b'{"error": "bad json"}\n')
-                    f.flush()
-                    continue
-                if msg.get("cmd") == "finish":
-                    self.finish()
-                    f.write(b'{"ok": true, "finished": true}\n')
-                    f.flush()
+                    msg = proto.recv()
+                except ConnectionError:
                     return
-                self.feed(numpy.asarray(msg["data"], numpy.float32))
-                f.write(b'{"ok": true}\n')
-                f.flush()
+                except json.JSONDecodeError:
+                    proto.send({"error": "bad json"})
+                    continue
+                if isinstance(msg, dict) and msg.get("cmd") == "finish":
+                    self.finish()
+                    proto.send({"ok": True, "finished": True})
+                    return
+                try:
+                    sample = numpy.asarray(msg["data"], numpy.float32)
+                except (TypeError, KeyError, IndexError, ValueError) as exc:
+                    # a bad item must neither kill this connection's
+                    # thread nor leave the producer blocked on its ack
+                    proto.send({"error": str(exc) or type(exc).__name__})
+                    continue
+                self.feed(sample)
+                proto.send({"ok": True})
 
     def stop_serving(self):
         self._accepting_ = False
