@@ -1,0 +1,166 @@
+"""Ensemble layer tests: manager farming, trainer/tester with in-process
+runners, metric aggregation, and the stacking loader."""
+
+import json
+import os
+import tempfile
+import unittest
+
+import numpy
+
+from veles_tpu.backends import Device
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.ensemble import (EnsembleTester, EnsembleTrainer,
+                                aggregate_metrics)
+from veles_tpu.loader.ensemble import EnsembleLoader
+
+
+class TestEnsembleTrainer(unittest.TestCase):
+    def test_trains_all_members_and_writes_results(self):
+        fd, path = tempfile.mkstemp(suffix=".json")
+        os.close(fd)
+        try:
+            trainer = EnsembleTrainer(
+                "dummy_wf.py", size=4, train_ratio=0.75, result_file=path,
+                runner=lambda i: {"fitness": 0.9 - 0.1 * i,
+                                  "Snapshot": "/tmp/m%d.pickle" % i})
+            results = trainer.run()
+            self.assertEqual(len(results), 4)
+            with open(path) as f:
+                data = json.load(f)
+            self.assertEqual(data["size"], 4)
+            self.assertEqual(data["train_ratio"], 0.75)
+            self.assertEqual(len(data["fitnesses"]), 4)
+            self.assertAlmostEqual(data["fitnesses"][0], 0.9)
+        finally:
+            os.unlink(path)
+
+    def test_member_argv_carries_overrides(self):
+        trainer = EnsembleTrainer("wf.py", config_file="cfg.py", size=3,
+                                  train_ratio=0.5)
+        argv = trainer.model_argv(2, "/tmp/r.json")
+        joined = " ".join(argv)
+        self.assertIn("root.common.ensemble.model_index=2", joined)
+        self.assertIn("root.common.ensemble.size=3", joined)
+        self.assertIn("root.common.ensemble.train_ratio=0.5", joined)
+        self.assertIn("cfg.py", joined)
+        # distinct seeds per member
+        argv0 = trainer.model_argv(0, "/tmp/r.json")
+        self.assertNotEqual(argv[argv.index("-s") + 1],
+                            argv0[argv0.index("-s") + 1])
+
+    def test_validates_arguments(self):
+        with self.assertRaises(ValueError):
+            EnsembleTrainer("wf.py", size=0)
+        with self.assertRaises(ValueError):
+            EnsembleTrainer("wf.py", size=2, train_ratio=1.5)
+
+    def test_task_farming_with_drop(self):
+        trainer = EnsembleTrainer("wf.py", size=3,
+                                  runner=lambda i: {"fitness": float(i)})
+        i1 = trainer.generate_data_for_slave("s1")
+        i2 = trainer.generate_data_for_slave("s2")
+        self.assertNotEqual(i1, i2)
+        trainer.drop_slave("s1")  # s1 dies: its model is requeued
+        i3 = trainer.generate_data_for_slave("s2")
+        self.assertEqual(i3, i1)
+        for idx, slave in ((i2, "s2"), (i3, "s2")):
+            trainer.apply_data_from_master(idx)
+            trainer.apply_data_from_slave(
+                trainer.generate_data_for_master(), slave)
+        self.assertEqual(trainer.processed, 2)
+        self.assertTrue(trainer.has_data_for_slave)
+
+
+class TestEnsembleTester(unittest.TestCase):
+    def _train_results(self):
+        return {"models": [{"fitness": 0.9, "Snapshot": "/tmp/a.pickle"},
+                           {"fitness": 0.8, "Snapshot": "/tmp/b.pickle"}],
+                "size": 2}
+
+    def test_reads_members_and_aggregates(self):
+        fd, path = tempfile.mkstemp(suffix=".json")
+        os.close(fd)
+        try:
+            tester = EnsembleTester(
+                "wf.py", results_file=self._train_results(),
+                result_file=path,
+                runner=lambda i: {"n_err": 10 + i, "loss": 0.5})
+            tester.run()
+            with open(path) as f:
+                data = json.load(f)
+            agg = data["aggregate"]
+            self.assertEqual(agg["n_err"]["mean"], 10.5)
+            self.assertEqual(agg["n_err"]["n"], 2)
+            self.assertEqual(agg["loss"]["std"], 0.0)
+        finally:
+            os.unlink(path)
+
+    def test_snapshot_argv(self):
+        tester = EnsembleTester("wf.py", results_file=self._train_results())
+        argv = tester.model_argv(1, "/tmp/r.json")
+        self.assertIn("/tmp/b.pickle", argv)
+        self.assertIn("--test", argv)
+
+    def test_missing_snapshot_is_an_error(self):
+        tester = EnsembleTester(
+            "wf.py", results_file={"models": [{"fitness": 1.0}]})
+        with self.assertRaises(ValueError):
+            tester.model_argv(0, "/tmp/r.json")
+
+    def test_empty_results_rejected(self):
+        with self.assertRaises(ValueError):
+            EnsembleTester("wf.py", results_file={"models": []})
+
+
+class TestAggregate(unittest.TestCase):
+    def test_ignores_non_numeric_and_bools(self):
+        agg = aggregate_metrics([{"a": 1.0, "flag": True, "s": "x"},
+                                 {"a": 3.0}])
+        self.assertEqual(set(agg), {"a"})
+        self.assertEqual(agg["a"]["mean"], 2.0)
+        self.assertEqual(agg["a"]["max"], 3.0)
+
+
+class TestEnsembleLoader(unittest.TestCase):
+    def _data(self, n=12, members=3, classes=4):
+        rng = numpy.random.RandomState(0)
+        labels = rng.randint(0, classes, n).tolist()
+        return {"models": [
+            {"Output": rng.rand(n, classes).tolist(), "Labels": labels}
+            for _ in range(members)]}
+
+    def test_stacks_member_outputs(self):
+        wf = DummyWorkflow()
+        loader = EnsembleLoader(wf, data=self._data(), minibatch_size=4)
+        loader.initialize(device=Device(backend="cpu"))
+        self.assertEqual(tuple(loader.original_data.shape), (12, 3, 4))
+        self.assertEqual(loader.class_lengths[2], 12)  # TRAIN
+        self.assertEqual(len(loader.original_labels.mem), 12)
+
+    def test_shape_mismatch_rejected(self):
+        data = self._data()
+        data["models"][1]["Output"] = data["models"][1]["Output"][:5]
+        wf = DummyWorkflow()
+        loader = EnsembleLoader(wf, data=data)
+        with self.assertRaises(ValueError):
+            loader.load_dataset()
+
+    def test_label_order_mismatch_rejected(self):
+        data = self._data()
+        data["models"][2]["Labels"] = list(
+            reversed(data["models"][2]["Labels"]))
+        wf = DummyWorkflow()
+        loader = EnsembleLoader(wf, data=data)
+        with self.assertRaises(ValueError):
+            loader.load_dataset()
+
+    def test_member_without_output_rejected(self):
+        wf = DummyWorkflow()
+        loader = EnsembleLoader(wf, data={"models": [{"fitness": 1.0}]})
+        with self.assertRaises(ValueError):
+            loader.load_dataset()
+
+
+if __name__ == "__main__":
+    unittest.main()

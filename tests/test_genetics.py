@@ -1,0 +1,205 @@
+"""Genetics engine tests (reference strategy: veles/tests had GA covered
+through optimization workflow runs; here we unit-test the engine plus an
+in-process optimizer convergence run)."""
+
+import json
+import os
+import tempfile
+import unittest
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.config import Config, root
+from veles_tpu.genetics import (Chromosome, GeneticsOptimizer, Population,
+                                Tune, collect_tuneables, fix_config,
+                                gray_decode, gray_encode)
+
+
+class TestGrayCode(unittest.TestCase):
+    def test_roundtrip(self):
+        n = numpy.arange(1 << 16, dtype=numpy.int64)
+        self.assertTrue((gray_decode(gray_encode(n)) == n).all())
+
+    def test_adjacent_codes_differ_by_one_bit(self):
+        n = numpy.arange((1 << 16) - 1, dtype=numpy.int64)
+        diff = gray_encode(n) ^ gray_encode(n + 1)
+        popcount = numpy.array([bin(int(d)).count("1") for d in diff[:500]])
+        self.assertTrue((popcount == 1).all())
+
+
+class TestChromosome(unittest.TestCase):
+    def setUp(self):
+        self.rand = prng.RandomGenerator("t").seed(7)
+
+    def test_numeric_within_bounds_after_mutation(self):
+        c = Chromosome([-5.0, 0.0], [5.0, 1.0], rand=self.rand)
+        for kind in ("binary_point", "altering", "gaussian", "uniform"):
+            for _ in range(20):
+                c.mutate(kind, n_points=3, probability=1.0, rand=self.rand)
+                num = c.numeric
+                self.assertTrue((num >= [-5.0, 0.0]).all(), (kind, num))
+                self.assertTrue((num <= [5.0, 1.0]).all(), (kind, num))
+
+    def test_encode_decode_accuracy(self):
+        c = Chromosome([0.0], [10.0], values=[3.14159], rand=self.rand)
+        self.assertAlmostEqual(c.numeric[0], 3.14159, places=3)
+
+    def test_copy_independent(self):
+        c = Chromosome([0.0], [1.0], values=[0.5], rand=self.rand)
+        c.fitness = 1.0
+        d = c.copy()
+        d.mutate("uniform", 5, 1.0, rand=self.rand)
+        self.assertEqual(c.fitness, 1.0)
+        self.assertIsNone(d.fitness)
+        self.assertAlmostEqual(c.numeric[0], 0.5, places=3)
+
+
+class TestPopulation(unittest.TestCase):
+    def test_evolves_toward_optimum(self):
+        rand = prng.RandomGenerator("t2").seed(42)
+        pop = Population([-10.0, -10.0], [10.0, 10.0], size=24, rand=rand)
+
+        def fitness(values):  # peak at (3, -2)
+            return -((values[0] - 3.0) ** 2 + (values[1] + 2.0) ** 2)
+
+        for _ in range(15):
+            for c in pop.pending:
+                c.fitness = fitness(c.numeric)
+            pop.update()
+        for c in pop.pending:
+            c.fitness = fitness(c.numeric)
+        best = pop.best
+        self.assertGreater(best.fitness, -1.0, best)
+        self.assertEqual(pop.generation, 15)
+
+    def test_crossovers_produce_valid_children(self):
+        rand = prng.RandomGenerator("t3").seed(3)
+        pop = Population([0.0] * 3, [1.0] * 3, size=4, rand=rand)
+        a, b = pop[0], pop[1]
+        for kind in pop.crossovers:
+            child = getattr(pop, "cross_" + kind)(a, b)
+            self.assertEqual(child.size, 3)
+            self.assertTrue((child.numeric >= 0.0).all())
+            self.assertTrue((child.numeric <= 1.0).all())
+
+    def test_update_requires_all_evaluated(self):
+        rand = prng.RandomGenerator("t4").seed(4)
+        pop = Population([0.0], [1.0], size=4, rand=rand)
+        with self.assertRaises(ValueError):
+            pop.update()
+
+
+class TestTuneConfig(unittest.TestCase):
+    def setUp(self):
+        self._saved = root.__dict__.pop("_ga_test_", None)
+
+    def tearDown(self):
+        root.__dict__.pop("_ga_test", None)
+        if "ga_test" in root.__dict__:
+            del root.__dict__["ga_test"]
+
+    def test_tune_behaves_as_float(self):
+        t = Tune(0.03, 0.001, 0.1)
+        self.assertEqual(t * 2, 0.06)
+        self.assertEqual(t.min_value, 0.001)
+
+    def test_collect_and_fix(self):
+        root.ga_test.lr = Tune(0.05, 0.01, 0.5)
+        root.ga_test.decay = 0.9
+        root.ga_test.sub.momentum = Tune(0.8, 0.0, 1.0)
+        found = collect_tuneables()
+        paths = [p for p, _ in found]
+        self.assertIn("root.ga_test.lr", paths)
+        self.assertIn("root.ga_test.sub.momentum", paths)
+        self.assertNotIn("root.ga_test.decay", paths)
+        fix_config()
+        self.assertNotIsInstance(root.ga_test.lr, Tune)
+        self.assertEqual(root.ga_test.lr, 0.05)
+
+    def test_tune_pickles(self):
+        import pickle
+        t = pickle.loads(pickle.dumps(Tune(1.0, 0.0, 2.0)))
+        self.assertIsInstance(t, Tune)
+        self.assertEqual(t.max_value, 2.0)
+
+
+class TestOptimizer(unittest.TestCase):
+    def tearDown(self):
+        if "ga_opt" in root.__dict__:
+            del root.__dict__["ga_opt"]
+
+    def test_in_process_optimization(self):
+        root.ga_opt.x = Tune(0.0, -4.0, 4.0)
+        root.ga_opt.y = Tune(0.0, -4.0, 4.0)
+        rand = prng.RandomGenerator("t5").seed(11)
+        fd, path = tempfile.mkstemp(suffix=".json")
+        os.close(fd)
+        try:
+            opt = GeneticsOptimizer(
+                generations=8, population_size=16,
+                evaluator=lambda v: -((v["root.ga_opt.x"] - 1.0) ** 2 +
+                                      (v["root.ga_opt.y"] - 2.0) ** 2),
+                result_file=path, rand=rand)
+            best = opt.run()
+            self.assertGreater(best.fitness, -0.5)
+            with open(path) as f:
+                results = json.load(f)
+            self.assertIn("root.ga_opt.x", results["config"])
+            self.assertEqual(results["fitness"], best.fitness)
+        finally:
+            os.unlink(path)
+
+    def test_requires_tuneables(self):
+        with self.assertRaises(ValueError):
+            GeneticsOptimizer(evaluator=lambda v: 0.0)
+
+    def test_fitness_from_results_fallback(self):
+        root.ga_opt.x = Tune(0.0, -1.0, 1.0)
+        opt = GeneticsOptimizer(evaluator=lambda v: 0.0)
+        self.assertEqual(opt._fitness_from_results({"fitness": 2.5}), 2.5)
+        # no fitness key: negated first numeric metric (errors minimized)
+        self.assertEqual(
+            opt._fitness_from_results({"validation error": 1.5}), -1.5)
+
+    def test_task_farming_protocol(self):
+        root.ga_opt.x = Tune(0.0, -4.0, 4.0)
+        rand = prng.RandomGenerator("t6").seed(13)
+        opt = GeneticsOptimizer(
+            generations=2, population_size=4,
+            evaluator=lambda v: -abs(v["root.ga_opt.x"]), rand=rand)
+        # master side hands out jobs; "slave" evaluates; master applies
+        jobs = []
+        while True:
+            job = opt.generate_data_for_slave("slave0")
+            if job is None:
+                break
+            jobs.append(job)
+            opt.apply_data_from_master(job)
+            update = opt.generate_data_for_master()
+            opt.apply_data_from_slave(update, "slave0")
+            if opt.population.generation >= opt.generations - 1 and \
+                    not opt.population.pending:
+                break
+        self.assertFalse(opt.population.pending)
+        self.assertIsNotNone(opt.population.best)
+        self.assertGreaterEqual(len(jobs), 4)
+
+    def test_drop_slave_requeues(self):
+        root.ga_opt.x = Tune(0.0, -1.0, 1.0)
+        rand = prng.RandomGenerator("t7").seed(17)
+        opt = GeneticsOptimizer(generations=1, population_size=3,
+                                evaluator=lambda v: 0.0, rand=rand)
+        job = opt.generate_data_for_slave("s1")
+        self.assertIsNotNone(job)
+        held = list(opt._dispatched_["s1"])
+        opt.drop_slave("s1")
+        self.assertNotIn("s1", opt._dispatched_)
+        # the chromosome is pending again and re-dispatched to another slave
+        job2 = opt.generate_data_for_slave("s2")
+        self.assertEqual(job2["index"], job["index"])
+        self.assertIs(opt.population.chromosomes[job2["index"]], held[0])
+
+
+if __name__ == "__main__":
+    unittest.main()

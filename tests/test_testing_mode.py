@@ -1,0 +1,121 @@
+"""--test (forward-only) mode + evaluator output recording.
+
+Covers the code-review findings: pad-row trimming in recorded outputs,
+recording restricted to the testing pass, and the one-epoch forward-only
+decision semantics."""
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.models.mnist import MnistWorkflow
+
+
+def _provider(n_train=50, n_valid=22, seed=3):
+    # n_valid=22 with minibatch_size 8 → last minibatch padded (22=2*8+6)
+    rng = numpy.random.RandomState(seed)
+
+    def provide():
+        def mk(n):
+            return (rng.rand(n, 6, 6).astype(numpy.float32),
+                    rng.randint(0, 10, n).astype(numpy.int32))
+        tx, ty = mk(n_train)
+        vx, vy = mk(n_valid)
+        return tx, ty, vx, vy
+    return provide
+
+
+def _module_provider():
+    """Module-level (picklable) provider for snapshot tests."""
+    return _provider()()
+
+
+def _build(max_epochs=1, **kwargs):
+    prng.get().seed(9)
+    prng.get("loader").seed(10)
+    wf = MnistWorkflow(provider=_provider(), layers=(8,),
+                       minibatch_size=8, max_epochs=max_epochs, **kwargs)
+    wf.initialize(device=Device(backend="cpu"))
+    return wf
+
+
+def test_training_does_not_record_outputs():
+    wf = _build()
+    wf.evaluator.publish_output = True
+    wf.run()
+    # recording only happens in testing mode — training must not grow it
+    assert wf.evaluator.recorded_outputs == []
+    assert "Output" not in wf.evaluator.get_metric_values()
+
+
+def test_testing_pass_records_trimmed_outputs():
+    wf = _build()
+    wf.evaluator.publish_output = True
+    wf.set_testing(True)
+    wf.run()
+    assert bool(wf.decision.complete)
+    metrics = wf.evaluator.get_metric_values()
+    # one clean pass over validation(22) + train(50): no pad rows
+    out = numpy.asarray(metrics["Output"])
+    labels = numpy.asarray(metrics["Labels"])
+    assert out.shape == (72, 10)
+    assert labels.shape == (72,)
+    assert (labels >= 0).all()
+
+
+def test_testing_runs_exactly_one_epoch():
+    wf = _build(max_epochs=5)
+    wf.set_testing(True)
+    weights_before = [numpy.array(f.weights.mem, copy=True)
+                      for f in wf.forwards]
+    wf.run()
+    assert len(wf.decision.epoch_history) == 1
+    # forward-only: weights untouched
+    for fwd, before in zip(wf.forwards, weights_before):
+        numpy.testing.assert_array_equal(fwd.weights.mem, before)
+
+
+def test_set_testing_reopens_completed_workflow():
+    wf = _build(max_epochs=1)
+    wf.run()
+    assert bool(wf.decision.complete)
+    wf.set_testing(True)
+    assert not bool(wf.decision.complete)
+
+
+def test_record_trims_by_labels_when_batch_size_unlinked():
+    from veles_tpu.nn.evaluator import EvaluatorSoftmax
+    from veles_tpu.dummy import DummyWorkflow
+    ev = EvaluatorSoftmax(DummyWorkflow(), publish_output=True)
+    ev.testing = True
+    out = numpy.random.rand(8, 4).astype(numpy.float32)
+    labels = numpy.array([1, 2, 3, 0, 2, -1, -1, -1])  # 3 pad rows
+    ev._record(out, labels)
+    assert ev.recorded_outputs[0].shape == (5, 4)
+    assert (ev.recorded_labels[0] >= 0).all()
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-q"]))
+
+
+def test_train_ratio_idempotent_across_reinitialize():
+    from veles_tpu.config import root
+    from veles_tpu.snapshotter import dump_workflow, load_workflow
+    root.common.ensemble.train_ratio = 0.8
+    try:
+        prng.get().seed(9)
+        prng.get("loader").seed(10)
+        wf = MnistWorkflow(provider=_module_provider, layers=(8,),
+                           minibatch_size=8, max_epochs=1)
+        wf.initialize(device=Device(backend="cpu"))
+        trimmed = wf.loader.class_lengths[2]
+        assert trimmed == 40  # 50 * 0.8
+        wf.run()
+        wf2 = load_workflow(dump_workflow(wf))
+        wf2.initialize(device=Device(backend="cpu"))
+        assert wf2.loader.class_lengths[2] == trimmed  # NOT 32 (40 * 0.8)
+    finally:
+        root.common.ensemble.train_ratio = 1.0

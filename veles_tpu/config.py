@@ -171,7 +171,7 @@ def _init_defaults():
         "web": {"host": "localhost", "port": 8090,
                 "notification_interval": 1.0},
         "forge": {"service_name": "forge", "manifest": "manifest.json"},
-        "ensemble": {"model_index": 0, "size": 0},
+        "ensemble": {"model_index": 0, "size": 0, "train_ratio": 1.0},
         "graphics": {"multicast_address": "239.192.1.1", "blacklisted_ifs": []},
     })
 

@@ -1,0 +1,14 @@
+"""Model ensembling (``veles/ensemble/``).
+
+Train N independent models on seed-varied, ``train_ratio``-subsampled
+data, gather every model's metrics into one results JSON, then evaluate
+the ensemble on a test set — the reference's third parallelism strategy
+(SURVEY.md §2.4): each model is a whole training run farmed out as a
+subprocess (``veles/ensemble/base_workflow.py:59-166``) or a slave job.
+"""
+
+from veles_tpu.ensemble.base import EnsembleManagerBase  # noqa: F401
+from veles_tpu.ensemble.train import (EnsembleTrainer,  # noqa: F401
+                                      EnsembleTrainManager)
+from veles_tpu.ensemble.test import (EnsembleTester,  # noqa: F401
+                                     aggregate_metrics)

@@ -1,0 +1,154 @@
+"""Shared ensemble machinery: subprocess evaluation + task farming.
+
+Re-designs ``veles/ensemble/base_workflow.py:59-166``
+(EnsembleModelManagerBase): a slot table of per-model results, jobs
+handed to slaves through IDistributable with pending-tracking and
+requeue-on-drop, and a ``_exec`` helper that runs one model as a
+``python -m veles_tpu`` subprocess reading metrics back from a results
+file.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+from veles_tpu.distributable import Distributable, IDistributable
+
+
+class EnsembleManagerBase(Distributable, IDistributable):
+    """N result slots; each job = one model index to process."""
+
+    def __init__(self, workflow_file=None, config_file=None, size=1,
+                 result_file=None, seed_base=1234, extra_argv=(),
+                 runner=None, **kwargs):
+        super(EnsembleManagerBase, self).__init__(**kwargs)
+        if int(size) < 1:
+            raise ValueError("ensemble size must be > 0 (got %s)" % size)
+        self.workflow_file = workflow_file
+        self.config_file = config_file
+        self.size = int(size)
+        self.results = [None] * self.size
+        self.result_file = result_file
+        self.seed_base = int(seed_base)
+        self.extra_argv = list(extra_argv)
+        self.runner = runner  # callable(index) -> dict, for tests/in-proc
+
+    def init_unpickled(self):
+        super(EnsembleManagerBase, self).init_unpickled()
+        self._pending_ = {}
+
+    # -- progress ----------------------------------------------------------
+
+    @property
+    def processed(self):
+        return sum(1 for r in self.results if r is not None)
+
+    @property
+    def pending_indices(self):
+        held = {i for s in self._pending_.values() for i in s}
+        return [i for i, r in enumerate(self.results)
+                if r is None and i not in held]
+
+    @property
+    def complete(self):
+        return self.processed == self.size
+
+    # -- one model ---------------------------------------------------------
+
+    def model_overrides(self, index):
+        """Config overrides marking which ensemble member this run is."""
+        return {"root.common.ensemble.model_index": index,
+                "root.common.ensemble.size": self.size}
+
+    def model_argv(self, index, result_path):
+        raise NotImplementedError
+
+    def process_model(self, index):
+        """Run model #index, return its results dict."""
+        if self.runner is not None:
+            return self.runner(index)
+        fd, result_path = tempfile.mkstemp(
+            suffix=".json", prefix="veles_tpu_ensemble_")
+        os.close(fd)
+        try:
+            argv = self.model_argv(index, result_path)
+            self.debug("exec: %s", " ".join(argv))
+            proc = subprocess.run(argv, stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT)
+            if proc.returncode != 0:
+                self.warning(
+                    "model #%d failed (%d): %s", index, proc.returncode,
+                    proc.stdout[-2000:].decode(errors="replace"))
+                return None
+            with open(result_path) as f:
+                return json.load(f)
+        finally:
+            try:
+                os.unlink(result_path)
+            except OSError:
+                pass
+
+    def _base_argv(self, result_path, seed):
+        argv = [sys.executable, "-m", "veles_tpu", self.workflow_file]
+        if self.config_file:
+            argv.append(self.config_file)
+        argv.extend(["--result-file", result_path, "-s", str(seed),
+                     "-v", "warning"])
+        argv.extend(self.extra_argv)
+        return argv
+
+    # -- driver ------------------------------------------------------------
+
+    def run(self):
+        for index in range(self.size):
+            if self.results[index] is None:
+                self.info("processing model %d / %d", index + 1, self.size)
+                self.results[index] = self.process_model(index)
+        self.write_results()
+        return self.results
+
+    def gathered(self):
+        """The dict written to result_file; subclasses extend."""
+        return {"models": self.results, "size": self.size}
+
+    def write_results(self):
+        if not self.result_file:
+            return
+        with open(self.result_file, "w") as f:
+            json.dump(self.gathered(), f, indent=2, default=str)
+        self.info("wrote ensemble results to %s", self.result_file)
+
+    # -- task farming (``base_workflow.py:103-131``) -----------------------
+
+    @property
+    def has_data_for_slave(self):
+        return bool(self.pending_indices)
+
+    def generate_data_for_slave(self, slave):
+        free = self.pending_indices
+        if not free:
+            return None
+        index = free[0]
+        self._pending_.setdefault(slave, set()).add(index)
+        self.info("enqueued model #%d / %d to %s", index + 1, self.size,
+                  slave)
+        return index
+
+    def apply_data_from_master(self, data):
+        self._job_index_ = int(data)
+
+    def generate_data_for_master(self):
+        return (self._job_index_, self.process_model(self._job_index_))
+
+    def apply_data_from_slave(self, data, slave):
+        index, result = data
+        self._pending_.get(slave, set()).discard(index)
+        self.results[index] = result
+
+    def drop_slave(self, slave):
+        requeued = self._pending_.pop(slave, set())
+        if requeued:
+            self.info("slave %s dropped, requeued models %s", slave,
+                      sorted(requeued))

@@ -1,0 +1,21 @@
+"""Genetic hyperparameter optimization (``veles/genetics/``).
+
+The reference optimizes ``Config`` tuneables with gray-coded chromosomes
+and a population evolved by roulette/tournament selection, four crossover
+and four mutation operators (``veles/genetics/core.py:133-801``); fitness
+of a chromosome is a full training run executed in a subprocess
+(``veles/genetics/optimization_workflow.py:223-288``), farmed out to
+slaves through the IDistributable protocol.
+
+This package re-provides that capability TPU-natively: evaluation runs
+are ordinary ``veles_tpu`` training invocations (each a single-controller
+JAX process owning the chip), so the genetic layer stays pure host-side
+Python and parallelism is population-level task farming — exactly the
+reference's model (SURVEY.md §2.4 strategy 2).
+"""
+
+from veles_tpu.genetics.core import (Chromosome, Population,  # noqa: F401
+                                     gray_encode, gray_decode)
+from veles_tpu.genetics.optimizer import (GeneticsOptimizer,  # noqa: F401
+                                          Tune, fix_config,
+                                          collect_tuneables)

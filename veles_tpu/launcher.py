@@ -175,6 +175,13 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         if self.graphics and not root.common.disable.get("plotting", True):
             self._launch_graphics()
         self.workflow.add_finished_callback(self.on_workflow_finished)
+        if self.testing:
+            set_testing = getattr(self.workflow, "set_testing", None)
+            if set_testing is not None:
+                set_testing(True)
+            else:
+                self.warning("--test requested but %s has no set_testing",
+                             type(self.workflow).__name__)
         self.workflow.initialize(device=self.device, **kwargs)
         if self.is_master:
             self._start_master()

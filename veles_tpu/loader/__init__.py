@@ -10,6 +10,7 @@ from veles_tpu.loader.base import (CLASS_NAMES, TEST, TRAIN, VALIDATION,  # noqa
                                    Loader, UserLoaderRegistry)
 from veles_tpu.loader.fullbatch import (FullBatchLoader,  # noqa: F401
                                         FullBatchLoaderMSE)
+from veles_tpu.loader.ensemble import EnsembleLoader  # noqa: F401
 from veles_tpu.loader.hdf5 import HDF5Loader  # noqa: F401
 from veles_tpu.loader.image import (AutoLabelFileImageLoader,  # noqa: F401
                                     FileImageLoader, ImageLoaderMSE)

@@ -27,6 +27,7 @@ Semantics kept from the reference:
 import numpy
 
 from veles_tpu import prng
+from veles_tpu.config import root
 from veles_tpu.memory import Array
 from veles_tpu.mutable import Bool
 from veles_tpu.units import Unit
@@ -59,7 +60,11 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
 
     def __init__(self, workflow, **kwargs):
         self.max_minibatch_size = kwargs.pop("minibatch_size", 100)
-        self.train_ratio = kwargs.pop("train_ratio", 1.0)
+        # root.common.ensemble.train_ratio lets meta-runs (ensemble
+        # training, ``veles/ensemble/model_workflow.py:101``) subsample
+        # the train set without touching the workflow file.
+        self.train_ratio = kwargs.pop(
+            "train_ratio", root.common.ensemble.get("train_ratio", 1.0))
         self.shuffle_limit = kwargs.pop("shuffle_limit", numpy.inf)
         self.rand_name = kwargs.pop("rand", "loader")
         super(Loader, self).__init__(workflow, **kwargs)
@@ -131,8 +136,13 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         if self.total_samples == 0:
             raise ValueError("%s loaded an empty dataset" % self.name)
         if self.train_ratio < 1.0 and self.class_lengths[TRAIN]:
+            # idempotent across re-initialize (snapshot resume): the
+            # ratio always applies to the ORIGINAL train length, or a
+            # resumed loader would shrink its train set a second time
+            if getattr(self, "_full_train_length", None) is None:
+                self._full_train_length = self.class_lengths[TRAIN]
             self.class_lengths[TRAIN] = max(1, int(
-                self.class_lengths[TRAIN] * self.train_ratio))
+                self._full_train_length * self.train_ratio))
         self.max_minibatch_size = min(self.max_minibatch_size, max(
             length for length in self.class_lengths if length) if any(
                 self.class_lengths) else self.max_minibatch_size)

@@ -1,0 +1,82 @@
+"""Ensemble-results loader (``veles/loader/ensemble.py``).
+
+Feeds the stacked per-member predictions from an ensemble results JSON
+(``EnsembleTester`` output, each member carrying ``Output`` and
+``Labels`` lists) as the dataset of a stacking meta-model: sample #i is
+the ``(n_members, n_classes)`` matrix of member outputs for input #i.
+
+Label handling at parity with the reference (``loader/ensemble.py:100+``):
+the first member's labels define the mapping; members whose labels
+disagree in order but not in content get their output columns remapped,
+members with different label *sets* are an error.
+"""
+
+import json
+
+import numpy
+
+from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+
+class EnsembleLoader(FullBatchLoader):
+    """Member predictions from a results JSON as a device-resident batch."""
+
+    MAPPING = "ensemble"
+
+    def __init__(self, workflow, **kwargs):
+        self.file = kwargs.pop("file", None)
+        self.data = kwargs.pop("data", None)  # already-parsed (tests)
+        super(EnsembleLoader, self).__init__(workflow, **kwargs)
+
+    def _read(self):
+        if self.data is not None:
+            return self.data
+        if not self.file:
+            raise ValueError("EnsembleLoader needs file= or data=")
+        with open(self.file) as f:
+            return json.load(f)
+
+    def load_dataset(self):
+        data = self._read()
+        members = [m for m in data.get("models", []) if isinstance(m, dict)]
+        if not members:
+            raise ValueError("no member results in %s" % (self.file,))
+        outputs, labels_ref = [], None
+        for index, member in enumerate(members):
+            if "Output" not in member:
+                raise ValueError("member #%d has no recorded Output "
+                                 "(train members with publish_output=True)"
+                                 % index)
+            output = numpy.asarray(member["Output"], dtype=numpy.float32)
+            labels = member.get("Labels")
+            if output.shape[0] == 0:
+                raise ValueError("member #%d recorded an empty Output"
+                                 % index)
+            if outputs and output.shape != outputs[0].shape:
+                raise ValueError(
+                    "member #%d output shape %s != member #0 shape %s" %
+                    (index, output.shape, outputs[0].shape))
+            if labels is not None:
+                labels = numpy.asarray(labels)
+                if labels_ref is None:
+                    labels_ref = labels
+                elif not numpy.array_equal(labels, labels_ref):
+                    raise ValueError(
+                        "member #%d saw samples in a different order — "
+                        "re-run member tests with a fixed seed" % index)
+            outputs.append(output)
+        stacked = numpy.stack(outputs, axis=1)  # (n, members, classes)
+        self.original_data.reset(stacked)
+        if labels_ref is not None:
+            self.original_labels.reset(
+                labels_ref.astype(numpy.int32).reshape(len(labels_ref)))
+        klass = TEST if self.testing else TRAIN
+        self.class_lengths[TEST] = self.class_lengths[VALIDATION] = \
+            self.class_lengths[TRAIN] = 0
+        self.class_lengths[klass] = stacked.shape[0]
+
+    @property
+    def testing(self):
+        launcher = self.launcher
+        return bool(getattr(launcher, "testing", False))

@@ -39,6 +39,41 @@ class FullBatchLoader(Loader):
         """Fill original_data/original_labels + class_lengths."""
         raise NotImplementedError
 
+    def load_class_files(self, paths, reader, kind="data"):
+        """Assemble the dataset from per-class files.
+
+        ``paths`` = (test, validation, train) paths (None = absent
+        class); ``reader(path) -> (data, labels-or-None)``. Shared by
+        the pickle/HDF5 loaders; enforces the alignment rules: labels
+        match their data length, and either every class file carries
+        labels or none does (labels gather by global sample index — a
+        partial label set would silently misalign classes).
+        """
+        data_parts, label_parts = [], []
+        for klass, path in enumerate(paths):
+            if path is None:
+                continue
+            data, labels = reader(path)
+            self.class_lengths[klass] = len(data)
+            data_parts.append(data)
+            if labels is not None:
+                if len(labels) != len(data):
+                    raise ValueError(
+                        "%s: %d labels for %d samples in %s" %
+                        (self.name, len(labels), len(data), path))
+                label_parts.append(labels)
+        if not data_parts:
+            raise ValueError("%s: no %s paths given" % (self.name, kind))
+        if label_parts and len(label_parts) != len(data_parts):
+            raise ValueError(
+                "%s: %d of %d class files carry labels — need all or "
+                "none" % (self.name, len(label_parts), len(data_parts)))
+        self.original_data.reset(numpy.concatenate(data_parts))
+        if label_parts:
+            self.original_labels.reset(numpy.concatenate(label_parts))
+        else:
+            self.has_labels = False
+
     def load_data(self):
         if self.original_data.mem is not None:
             # restored from snapshot: data (already normalized) came

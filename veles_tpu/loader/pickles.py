@@ -30,27 +30,6 @@ class PicklesLoader(FullBatchLoader):
         return numpy.asarray(blob, numpy.float32), None
 
     def load_dataset(self):
-        data_parts, label_parts = [], []
-        for klass, path in enumerate((self.test_path,
-                                      self.validation_path,
-                                      self.train_path)):
-            if path is None:
-                continue
-            data, labels = self._read(path)
-            self.class_lengths[klass] = len(data)
-            data_parts.append(data)
-            if labels is not None:
-                label_parts.append(labels)
-        if not data_parts:
-            raise ValueError("%s: no pickle paths given" % self.name)
-        self.original_data.reset(numpy.concatenate(data_parts))
-        if label_parts and len(label_parts) != len(data_parts):
-            # labels gather by global sample index: a partial label set
-            # would silently misalign classes against samples
-            raise ValueError(
-                "%s: %d of %d class files carry labels — need all or "
-                "none" % (self.name, len(label_parts), len(data_parts)))
-        if label_parts:
-            self.original_labels.reset(numpy.concatenate(label_parts))
-        else:
-            self.has_labels = False
+        self.load_class_files(
+            (self.test_path, self.validation_path, self.train_path),
+            self._read, kind="pickle")

@@ -118,6 +118,8 @@ class MnistWorkflow(AcceleratedWorkflow):
         self.evaluator.link_attrs(head, "output")
         self.evaluator.link_attrs(self.loader,
                                   ("labels", "minibatch_labels"))
+        self.evaluator.link_attrs(self.loader,
+                                  ("batch_size", "minibatch_size"))
 
         self.decision = DecisionGD(self, max_epochs=max_epochs,
                                    fail_iterations=fail_iterations,
@@ -149,3 +151,10 @@ class MnistWorkflow(AcceleratedWorkflow):
         self.repeater.gate_block = self.decision.complete
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
+
+    def set_testing(self, testing=True):
+        """Forward-only mode: one epoch, no weight updates (``--test``)."""
+        self.evaluator.testing = testing
+        self.decision.testing = testing
+        if testing:
+            self.decision.complete.value = False

@@ -35,6 +35,9 @@ class DecisionBase(Unit, IResultProvider):
         self.complete = Bool(False)
         self.improved = Bool(False)
         self.gd_skip = Bool(False)
+        # forward-only mode: gradients always skipped, stop after one
+        # full epoch (the ``--test`` pass)
+        self.testing = False
         self.epoch_stats = [dict() for _ in range(3)]
         self.epoch_history = []
         self.best_metric = numpy.inf
@@ -58,7 +61,7 @@ class DecisionBase(Unit, IResultProvider):
 
     def run(self):
         klass = self.minibatch_class
-        self.gd_skip <<= (klass != TRAIN)
+        self.gd_skip <<= (klass != TRAIN) or self.testing
         metric = self.minibatch_metric()
         if self.is_slave:
             # one job = one minibatch: opening the end point after every
@@ -144,8 +147,14 @@ class DecisionBase(Unit, IResultProvider):
         # Withholding data (has_data_for_slave=False) idles job requests
         # until the laggard's updates close the old epoch.
         min_open = min(buckets) if buckets else None
+        # ... but never throttle while requeued minibatches (from a dead
+        # slave) are waiting: they belong to the oldest open epoch, and
+        # serving them is the only way that epoch can ever close.
+        loader = getattr(self.workflow, "loader", None)
+        requeued = bool(getattr(loader, "failed_minibatches", ()))
         self.has_data_for_slave = (
-            min_open is None or self.epoch_number - min_open <= 1)
+            requeued or min_open is None or
+            self.epoch_number - min_open <= 1)
         if bool(self.complete) and self.is_master:
             # the master's workflow never runs: propagate the stop
             # decision straight to the job source (NoMoreJobs)
@@ -185,6 +194,9 @@ class DecisionBase(Unit, IResultProvider):
                             stats_set[i].get("normalized", numpy.nan))
             for i in range(3) if self.class_lengths[i]))
         stop = False
+        if self.testing:
+            self.info("test pass complete")
+            stop = True
         if self.max_epochs is not None and epoch + 1 >= self.max_epochs:
             self.info("stopping: max_epochs=%d reached", self.max_epochs)
             stop = True

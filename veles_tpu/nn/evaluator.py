@@ -69,10 +69,24 @@ class EvaluatorBase(AcceleratedUnit, IResultProvider):
         self.output = None         # linked from the head forward unit
         self.err_output = Array()  # consumed by the GD chain
         self.testing = kwargs.get("testing", False)
+        # opt-in: accumulate per-minibatch outputs/labels and publish
+        # them in the results JSON — what the ensemble layer stacks on
+        # (``veles/loader/ensemble.py:64-75`` reads models[i]["Output"]).
+        # Recording only happens in testing (forward-only) mode: that is
+        # the single clean pass over one class the ensemble consumes;
+        # recording during training would mix train/validation outputs
+        # across epochs and grow without bound.
+        self.publish_output = kwargs.get("publish_output", False)
+        self.batch_size = None  # link from loader "minibatch_size"
+        self.recorded_outputs = []
+        self.recorded_labels = []
         self.demand("output")
 
     def initialize(self, device=None, **kwargs):
         super(EvaluatorBase, self).initialize(device=device, **kwargs)
+        # a fresh (or snapshot-resumed) pass starts a fresh recording
+        self.recorded_outputs = []
+        self.recorded_labels = []
         out = self.output
         mem = out.mem if isinstance(out, Array) else out
         self.err_output.reset(numpy.zeros(mem.shape, numpy.float32))
@@ -81,6 +95,32 @@ class EvaluatorBase(AcceleratedUnit, IResultProvider):
     def _output_devmem(self):
         return (self.output.devmem if isinstance(self.output, Array)
                 else self.output)
+
+    def _record(self, output, labels=None):
+        if not (self.publish_output and self.testing):
+            return
+        output = numpy.asarray(output)
+        labels = None if labels is None else numpy.asarray(labels)
+        # trim pad rows: the final minibatch is padded to max size
+        # (pad labels are -1, see ops/gather); padding is at the tail
+        if self.batch_size is not None:
+            n = int(self.batch_size)
+        elif labels is not None:
+            valid = numpy.flatnonzero(labels >= 0)
+            n = int(valid[-1]) + 1 if len(valid) else 0
+        else:
+            n = len(output)
+        self.recorded_outputs.append(output[:n])
+        if labels is not None:
+            self.recorded_labels.append(labels[:n])
+
+    def _recorded_metrics(self):
+        if not (self.publish_output and self.recorded_outputs):
+            return {}
+        out = {"Output": numpy.concatenate(self.recorded_outputs).tolist()}
+        if self.recorded_labels:
+            out["Labels"] = numpy.concatenate(self.recorded_labels).tolist()
+        return out
 
 
 class EvaluatorSoftmax(EvaluatorBase):
@@ -112,11 +152,14 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.max_err_output_sum = float(max_err)
         if confusion is not None:
             self.confusion_matrix = numpy.asarray(confusion)
+        self._record(probs, labels)
 
     numpy_run = jax_run  # same math through jax-on-host
 
     def get_metric_values(self):
-        return {"n_err": self.n_err, "loss": self.loss}
+        out = {"n_err": self.n_err, "loss": self.loss}
+        out.update(self._recorded_metrics())
+        return out
 
 
 class EvaluatorMSE(EvaluatorBase):
@@ -145,8 +188,11 @@ class EvaluatorMSE(EvaluatorBase):
                 err.reshape(self.err_output.shape))
         self.rmse = float(rmse)
         self.mse_per_sample = numpy.asarray(per_sample)
+        self._record(out)
 
     numpy_run = jax_run
 
     def get_metric_values(self):
-        return {"rmse": self.rmse}
+        out = {"rmse": self.rmse}
+        out.update(self._recorded_metrics())
+        return out

@@ -32,6 +32,7 @@ import time
 from veles_tpu import prng
 from veles_tpu.config import root
 from veles_tpu.mutable import Bool
+from veles_tpu.result_provider import IResultProvider
 from veles_tpu.units import Unit
 
 #: extension -> opener; "" means raw
@@ -60,7 +61,7 @@ def _open_for_read(path):
     return open(path, "rb")
 
 
-class SnapshotterBase(Unit):
+class SnapshotterBase(Unit, IResultProvider):
     """Gating + lifecycle; subclasses implement :meth:`export`.
 
     Gates (``veles/snapshotter.py:159-174``): a snapshot is taken every
@@ -104,6 +105,11 @@ class SnapshotterBase(Unit):
     def export(self):
         raise NotImplementedError
 
+    def get_metric_values(self):
+        """The newest snapshot path lands in the results JSON so meta-runs
+        (ensemble test) can reload members (``model_workflow.py:115-124``)."""
+        return {"Snapshot": self.destination} if self.destination else {}
+
 
 class SnapshotterToFile(SnapshotterBase):
     """Pickle the owning workflow (+PRNG registry) to a file.
@@ -121,6 +127,14 @@ class SnapshotterToFile(SnapshotterBase):
     def export(self):
         wf = self.workflow
         suffix = ("_" + self.suffix) if self.suffix else ""
+        # ensemble members run the same workflow file concurrently from
+        # the same CWD — each must write distinct snapshots (and distinct
+        # "_current" pointers) or members overwrite each other
+        # (``veles/ensemble/model_workflow.py`` separates them by log_id)
+        member_tag = ""
+        if root.common.ensemble.get("size", 0):
+            member_tag = "_m%d" % root.common.ensemble.get("model_index", 0)
+        suffix += member_tag
         ext = ("." + self.compression) if self.compression else ""
         name = "%s%s.%d.pickle%s" % (self.prefix, suffix,
                                      self._wf_epoch(wf), ext)
@@ -139,7 +153,7 @@ class SnapshotterToFile(SnapshotterBase):
             if os.path.exists(tmp):
                 os.unlink(tmp)
         self.destination = path
-        self._update_symlink(path, ext)
+        self._update_symlink(path, ext, member_tag)
         self.info("snapshotted to %s (%.1f MiB)", path,
                   len(payload) / 1048576.0)
 
@@ -153,9 +167,12 @@ class SnapshotterToFile(SnapshotterBase):
             return int(getattr(loader, "epoch_number", 0) or 0)
         return 0
 
-    def _update_symlink(self, path, ext=""):
-        link_path = os.path.join(self.directory,
-                                 "%s_current.pickle%s" % (self.prefix, ext))
+    def _update_symlink(self, path, ext="", member_tag=""):
+        # the member tag keeps concurrent ensemble members from racing
+        # over a shared "_current" pointer
+        link_path = os.path.join(
+            self.directory,
+            "%s%s_current.pickle%s" % (self.prefix, member_tag, ext))
         try:
             if os.path.islink(link_path) or os.path.exists(link_path):
                 os.unlink(link_path)

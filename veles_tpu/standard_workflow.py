@@ -121,6 +121,8 @@ class StandardWorkflow(AcceleratedWorkflow):
             raise ValueError("loss must be softmax or mse")
         self.evaluator.link_from(head)
         self.evaluator.link_attrs(head, "output")
+        self.evaluator.link_attrs(self.loader,
+                                  ("batch_size", "minibatch_size"))
         self.decision.link_from(self.evaluator)
         self.decision.link_attrs(self.loader, "minibatch_class",
                                  "last_minibatch", "epoch_ended",
@@ -156,8 +158,15 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.end_point.gate_block = ~self.decision.complete
 
     def set_testing(self, testing=True):
-        """Inference mode: dropout off, no err_output generation."""
+        """Inference mode: dropout off, no err_output generation, one
+        forward-only epoch (then the decision stops the loop) — what
+        ``--test`` and ensemble evaluation run."""
         self.evaluator.testing = testing
+        self.decision.testing = testing
+        if testing:
+            # a snapshot-resumed workflow arrives with complete=True;
+            # the test pass must re-open the loop for one epoch
+            self.decision.complete.value = False
         for fwd in self.forwards:
             if isinstance(fwd, DropoutForward):
                 fwd.testing = testing

@@ -62,6 +62,10 @@ class SocketFedLoader(QueueFedLoader):
                     return
                 try:
                     sample = numpy.asarray(msg["data"], numpy.float32)
+                    # reject wrong-size samples HERE, while the producer
+                    # still gets the error ack — once fed, the reshape in
+                    # fill_minibatch would crash the workflow run thread
+                    sample = sample.reshape(self.sample_shape)
                 except (TypeError, KeyError, IndexError, ValueError) as exc:
                     # a bad item must neither kill this connection's
                     # thread nor leave the producer blocked on its ack
