@@ -1,0 +1,201 @@
+"""RESTful inference API end-to-end (reference: tests/test_restful.py).
+
+A minimal service workflow — RestfulLoader → All2AllSoftmax →
+RESTfulAPI in a Repeater loop — is run on a thread while HTTP clients
+POST samples at it."""
+
+import base64
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.loader.restful import RestfulLoader
+from veles_tpu.nn.all2all import All2AllSoftmax
+from veles_tpu.plumbing import Repeater
+from veles_tpu.restful_api import RESTfulAPI
+
+
+def _post(address, payload, content_type="application/json", path="/api"):
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d%s" % (address[1], path),
+        data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": content_type}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def service():
+    prng.get().seed(11)
+    wf = AcceleratedWorkflow(DummyLauncher())
+    repeater = Repeater(wf)
+    repeater.link_from(wf.start_point)
+    loader = RestfulLoader(wf, sample_shape=(4,), feed_timeout=30)
+    loader.link_from(repeater)
+    fwd = All2AllSoftmax(wf, output_sample_shape=3, name="fc")
+    fwd.link_from(loader)
+    fwd.link_attrs(loader, ("input", "minibatch_data"))
+    api = RESTfulAPI(wf, port=0, response_timeout=10)
+    api.link_from(fwd)
+    api.link_attrs(fwd, ("input", "output"))
+    api.feed = loader.feed
+    repeater.link_from(api)
+    wf.initialize(device=Device(backend="cpu"))
+    thread = threading.Thread(target=wf.run, daemon=True)
+    thread.start()
+    try:
+        yield wf, api, loader
+    finally:
+        loader.finish()
+        thread.join(timeout=20)
+        api.stop()
+        assert not thread.is_alive()
+
+
+def test_list_codec_roundtrip(service):
+    wf, api, loader = service
+    status, reply = _post(api.address,
+                          {"input": [1.0, 2.0, 3.0, 4.0], "codec": "list"})
+    assert status == 200
+    result = numpy.asarray(reply["result"], numpy.float32)
+    assert result.shape == (3,)
+    # softmax output: a probability distribution
+    assert abs(result.sum() - 1.0) < 1e-4
+    assert (result > 0).all()
+
+
+def test_base64_codec_matches_list_codec(service):
+    wf, api, loader = service
+    sample = numpy.array([0.5, -1.0, 2.0, 0.0], numpy.float32)
+    _, via_list = _post(api.address,
+                        {"input": sample.tolist(), "codec": "list"})
+    status, via_b64 = _post(api.address, {
+        "input": base64.b64encode(sample.tobytes()).decode(),
+        "codec": "base64", "shape": [4], "type": "float32"})
+    assert status == 200
+    numpy.testing.assert_allclose(via_b64["result"], via_list["result"],
+                                  rtol=1e-5)
+
+
+def test_request_validation(service):
+    wf, api, loader = service
+    cases = [
+        # (payload, content-type, path, expected-status)
+        ({"input": [1, 2, 3, 4]}, "application/json", "/api", 400),
+        ({"codec": "list"}, "application/json", "/api", 400),
+        ({"input": [1], "codec": "nope"}, "application/json", "/api", 400),
+        ({"input": [1, 2], "codec": "list"}, "application/json", "/api", 400),
+        ({"input": "x", "codec": "base64"}, "application/json", "/api", 400),
+        ({"input": "x", "codec": "base64", "shape": [4]},
+         "application/json", "/api", 400),
+        ({"input": [1, 2, 3, 4], "codec": "list"}, "text/plain", "/api", 400),
+        ({"input": [1, 2, 3, 4], "codec": "list"},
+         "application/json", "/other", 404),
+    ]
+    for payload, ctype, path, want in cases:
+        status, reply = _post(api.address, payload,
+                              content_type=ctype, path=path)
+        assert status == want, (payload, ctype, path, status)
+        assert "error" in reply
+    # the service survives all of the above
+    status, reply = _post(api.address,
+                          {"input": [0, 0, 0, 0], "codec": "list"})
+    assert status == 200
+
+
+def test_concurrent_requests_all_answered(service):
+    wf, api, loader = service
+    results = {}
+
+    def ask(i):
+        sample = numpy.zeros(4, numpy.float32)
+        sample[i % 4] = float(i)
+        results[i] = _post(api.address,
+                           {"input": sample.tolist(), "codec": "list"})
+
+    threads = [threading.Thread(target=ask, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 8
+    for i, (status, reply) in results.items():
+        assert status == 200
+        assert len(reply["result"]) == 3
+
+
+def test_result_transform(service):
+    wf, api, loader = service
+    api.result_transform = lambda out: int(numpy.argmax(out))
+    status, reply = _post(api.address,
+                          {"input": [9.0, 0.0, 0.0, 0.0], "codec": "list"})
+    assert status == 200
+    assert isinstance(reply["result"], int)
+    assert 0 <= reply["result"] < 3
+
+
+def test_keepalive_connection_survives_fail_paths(service):
+    """Fail responses must drain the request body — otherwise the next
+    request on the same HTTP/1.1 connection parses leftover bytes."""
+    import http.client
+    wf, api, loader = service
+    conn = http.client.HTTPConnection("127.0.0.1", api.address[1], timeout=10)
+    try:
+        body = json.dumps({"input": [1, 2, 3, 4], "codec": "list"})
+        # 1st: wrong path (404 with a body that must be drained)
+        conn.request("POST", "/nope", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 404
+        resp.read()
+        # 2nd on the SAME connection: must work
+        conn.request("POST", "/api", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert len(json.loads(resp.read())["result"]) == 3
+    finally:
+        conn.close()
+
+
+def test_workflow_finish_stops_service(service):
+    wf, api, loader = service
+    loader.finish()
+    deadline = 50
+    while wf.is_running and deadline:
+        threading.Event().wait(0.1)
+        deadline -= 1
+    assert not wf.is_running
+    # the finished-callback shut the server down: new requests are refused
+    with pytest.raises((ConnectionError, urllib.error.URLError, OSError)):
+        _post(api.address, {"input": [0, 0, 0, 0], "codec": "list"})
+
+
+def test_base64_type_must_be_string(service):
+    wf, api, loader = service
+    status, reply = _post(api.address, {
+        "input": "AA==", "codec": "base64", "shape": [1], "type": 5})
+    assert status == 400 and "error" in reply
+    status, reply = _post(api.address, {"input": {"a": 1}, "codec": "list"})
+    assert status == 400 and "error" in reply
+
+
+def test_port_and_path_validation():
+    wf = AcceleratedWorkflow(DummyLauncher())
+    with pytest.raises(ValueError):
+        RESTfulAPI(wf, port="8080")
+    with pytest.raises(ValueError):
+        RESTfulAPI(wf, port=70000)
+    with pytest.raises(ValueError):
+        RESTfulAPI(wf, path="api")

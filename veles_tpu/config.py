@@ -170,6 +170,7 @@ def _init_defaults():
         "random_seed": None,
         "web": {"host": "localhost", "port": 8090,
                 "notification_interval": 1.0},
+        "api": {"host": "localhost", "port": 8180, "path": "/api"},
         "forge": {"service_name": "forge", "manifest": "manifest.json"},
         "ensemble": {"model_index": 0, "size": 0, "train_ratio": 1.0},
         "graphics": {"multicast_address": "239.192.1.1", "blacklisted_ifs": []},

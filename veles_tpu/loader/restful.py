@@ -6,6 +6,8 @@ the API unit reads the output back. Mechanism shared with the
 interactive loader (one queue-fed test minibatch per request).
 """
 
+import numpy
+
 from veles_tpu.loader.interactive import QueueFedLoader
 
 
@@ -15,3 +17,11 @@ class RestfulLoader(QueueFedLoader):
     def __init__(self, workflow, **kwargs):
         kwargs.setdefault("minibatch_size", 1)
         super(RestfulLoader, self).__init__(workflow, **kwargs)
+
+    def feed(self, sample):
+        """Validate the shape HERE, on the caller's (HTTP) thread —
+        once enqueued, a wrong-size sample would crash the workflow's
+        run loop in ``fill_minibatch`` instead of failing the request."""
+        sample = numpy.asarray(sample, numpy.float32)
+        sample = sample.reshape(self.sample_shape)
+        super(RestfulLoader, self).feed(sample)

@@ -1,0 +1,292 @@
+"""RESTful inference API (re-designs ``veles/restful_api.py:78-217``).
+
+Turns a trained workflow into an HTTP service: ``POST <path>`` with a
+JSON body ``{"input": <data>, "codec": "list"|"base64"[, "shape": [...],
+"type": "float32"]}`` feeds the decoded sample into the workflow's
+:class:`~veles_tpu.loader.restful.RestfulLoader`, the forward pass runs,
+and the response is ``{"result": <output row>}``. Malformed requests get
+``{"error": ...}`` with HTTP 400 — the same request contract (codec
+validation, base64 shape/type requirements) as the reference.
+
+The reference served through Twisted's reactor; here the server is a
+stdlib :class:`~http.server.ThreadingHTTPServer` on a daemon thread —
+the workflow side stays single-dispatch (the TPU-friendly scheduler in
+:mod:`veles_tpu.workflow`), requests rendezvous with it through the
+loader's feed queue and a matching FIFO of pending responses.
+
+Wiring (see ``tests/test_restful.py``)::
+
+    loader = RestfulLoader(wf, sample_shape=...)
+    api = RESTfulAPI(wf, port=0)
+    api.link_from(last_forward)
+    api.link_attrs(last_forward, ("input", "output"))
+    api.feed = loader.feed
+"""
+
+import base64
+import binascii
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.distributable import TriviallyDistributable
+from veles_tpu.units import Unit
+
+
+class _NumpyJSONEncoder(json.JSONEncoder):
+    """Serializes numpy scalars/arrays (``veles/json_encoders.py``)."""
+
+    def default(self, obj):
+        if isinstance(obj, numpy.ndarray):
+            return obj.tolist()
+        if isinstance(obj, numpy.integer):
+            return int(obj)
+        if isinstance(obj, numpy.floating):
+            return float(obj)
+        return super(_NumpyJSONEncoder, self).default(obj)
+
+
+class _APIHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route to the unit's logger
+        self.server.api.debug("http: " + fmt, *args)
+
+    def do_POST(self):
+        self.server.api.serve(self)
+
+
+class RESTfulAPI(Unit, TriviallyDistributable):
+    """Serves the owning workflow's forward pass over HTTP.
+
+    Demands ``feed`` (the loader's feed method) and ``input`` (the last
+    forward's output Array). ``result_transform``, if given, maps the
+    raw output row to the response payload (e.g. argmax labeling).
+    """
+
+    def __init__(self, workflow, **kwargs):
+        kwargs["view_group"] = "SERVICE"
+        super(RESTfulAPI, self).__init__(workflow, **kwargs)
+        self.host = kwargs.get("host", root.common.api.host)
+        self.port = kwargs.get("port", root.common.api.port)
+        self.path = kwargs.get("path", root.common.api.path)
+        self.result_transform = kwargs.get("result_transform", None)
+        #: seconds a request waits for the workflow before HTTP 500
+        self.response_timeout = kwargs.get("response_timeout", 60.0)
+        self.address = None
+        self.demand("feed", "input")
+
+    def init_unpickled(self):
+        super(RESTfulAPI, self).init_unpickled()
+        self._server_ = None
+        self._pending_ = []
+        self._pending_lock_ = threading.Lock()
+
+    # -- validated properties (reference parity) --------------------------
+
+    @property
+    def port(self):
+        return self._port
+
+    @port.setter
+    def port(self, value):
+        if not isinstance(value, int):
+            raise ValueError("port must be an integer (got %s)" % type(value))
+        if value < 0 or value > 65535:
+            raise ValueError("port is out of range (%d)" % value)
+        self._port = value
+
+    @property
+    def path(self):
+        return self._path
+
+    @path.setter
+    def path(self, value):
+        if not value.startswith("/"):
+            raise ValueError("Invalid path: %s" % value)
+        self._path = value
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def initialize(self, **kwargs):
+        self._server_ = ThreadingHTTPServer(
+            (self.host, self.port), _APIHandler)
+        self._server_.api = self
+        self._server_.daemon_threads = True
+        self.address = self._server_.server_address
+        self.port = self.address[1]
+        thread = threading.Thread(target=self._server_.serve_forever,
+                                  daemon=True, name="%s-http" % self.name)
+        thread.start()
+        # stop serving (and unblock waiters) the moment the workflow ends
+        from veles_tpu.workflow import Workflow
+        if isinstance(self.workflow, Workflow):
+            self.workflow.add_finished_callback(self.stop)
+        self.info("listening on %s:%d%s", self.host, self.port, self.path)
+
+    def stop(self):
+        if self._server_ is not None:
+            self._server_.shutdown()
+            self._server_.server_close()
+            self._server_ = None
+        # unblock any requests still waiting on the workflow
+        with self._pending_lock_:
+            pending, self._pending_ = self._pending_, []
+        for slot in pending:
+            slot["error"] = "service stopped"
+            slot["event"].set()
+
+    # -- workflow side -----------------------------------------------------
+
+    def run(self):
+        """One forward pass finished: answer the oldest request."""
+        with self._pending_lock_:
+            if not self._pending_:
+                return  # e.g. the EOF minibatch that stops the loop
+            slot = self._pending_.pop(0)
+        if slot["abandoned"]:
+            # its client already got a 504; the slot stayed in the FIFO
+            # so sample<->response correlation survives the timeout
+            return
+        out = numpy.array(self.input.map_read()[0], copy=True)
+        slot["result"] = (self.result_transform(out)
+                          if self.result_transform is not None else out)
+        slot["event"].set()
+
+    # -- HTTP side ---------------------------------------------------------
+
+    def fail(self, handler, message, code=400):
+        self.warning(message)
+        body = json.dumps({"error": message}).encode("utf-8")
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def _decode_base64(self, handler, request, input_obj):
+        """The base64 codec: needs "shape" and "type" attributes."""
+        if "shape" not in request:
+            self.fail(handler, "There is no \"shape\" attribute which "
+                               "defines the input array shape")
+            return None
+        shape = request["shape"]
+        if not isinstance(shape, list) or len(shape) < 1:
+            self.fail(handler, "\"shape\" must be a non-trivial array")
+            return None
+        if request.get("type") is None:
+            self.fail(handler, "There is no \"type\" attribute which "
+                               "defines the array data type (e.g., "
+                               "\"float32\" or \"uint8\", see numpy.dtype)")
+            return None
+        dtype_name = request["type"]
+        if not isinstance(dtype_name, str):
+            self.fail(handler, "\"type\" must be a string dtype name")
+            return None
+        byte_order = None
+        if dtype_name and dtype_name[-1] in "<=>":
+            byte_order = dtype_name[-1]
+            dtype_name = dtype_name[:-1]
+        try:
+            dtype = numpy.dtype(dtype_name)
+        except TypeError:
+            self.fail(handler, "Invalid \"type\" value. For the list of "
+                               "supported values, see numpy.dtype.")
+            return None
+        if byte_order is not None:
+            dtype = dtype.newbyteorder(byte_order)
+        try:
+            buf = base64.b64decode(input_obj)
+        except (binascii.Error, TypeError) as e:
+            self.fail(handler, "Failed to decode base64: %s." % e)
+            return None
+        try:
+            return numpy.frombuffer(buf, dtype).reshape(shape)
+        except Exception as e:
+            self.fail(handler, "Failed to create the numpy array: %s." % e)
+            return None
+
+    def serve(self, handler):
+        """Runs on the HTTP thread: decode, feed, wait, respond."""
+        # drain the body before ANY fail path: on a keep-alive
+        # connection unread body bytes would be parsed as the next
+        # request line, corrupting the client's following request
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            raw = handler.rfile.read(length)
+        except (TypeError, ValueError):
+            handler.close_connection = True
+            self.fail(handler, "Invalid Content-Length")
+            return
+        if handler.path != self.path:
+            self.fail(handler, "API path %s is not supported" % handler.path,
+                      code=404)
+            return
+        ctype = (handler.headers.get("Content-Type") or "").split(";")[0]
+        if ctype.strip() != "application/json":
+            self.fail(handler, "Unsupported Content-Type (must be "
+                               "\"application/json\")")
+            return
+        try:
+            request = json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            self.fail(handler, "Failed to parse JSON")
+            return
+        if not isinstance(request, dict) or "input" not in request \
+                or "codec" not in request:
+            self.fail(handler, "Invalid input format: there must be "
+                               "\"input\" and \"codec\" attributes")
+            return
+        codec = request["codec"]
+        if codec not in ("list", "base64"):
+            self.fail(handler, "Invalid codec value: must be either "
+                               "\"list\" or \"base64\"")
+            return
+        if codec == "list":
+            try:
+                data = numpy.array(request["input"], numpy.float32)
+            except (TypeError, ValueError):
+                self.fail(handler, "Invalid input array format")
+                return
+        else:
+            data = self._decode_base64(handler, request, request["input"])
+            if data is None:
+                return
+        slot = {"event": threading.Event(), "result": None, "error": None,
+                "abandoned": False}
+        # feed + pending append under one lock: the loader queue and the
+        # response FIFO must agree on ordering across HTTP threads
+        feed_error = None
+        with self._pending_lock_:
+            try:
+                self.feed(data)
+            except Exception as e:
+                feed_error = str(e) or type(e).__name__
+            else:
+                self._pending_.append(slot)
+        if feed_error is not None:
+            self.fail(handler, "Invalid input value: %s" % feed_error)
+            return
+        if not slot["event"].wait(self.response_timeout):
+            # do NOT remove the slot: the sample is already in the
+            # loader queue, so run() must still pop this slot when the
+            # pass completes or every later client would get the
+            # previous request's result
+            with self._pending_lock_:
+                slot["abandoned"] = True
+            self.fail(handler, "The workflow did not respond in time",
+                      code=500)
+            return
+        if slot["error"] is not None:
+            self.fail(handler, slot["error"], code=500)
+            return
+        body = json.dumps({"result": slot["result"]},
+                          cls=_NumpyJSONEncoder).encode("utf-8")
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
