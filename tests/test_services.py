@@ -1,0 +1,271 @@
+"""Shell unit, debug helpers, operator scripts, sound/HDFS loaders."""
+
+import io
+import json
+import subprocess
+import sys
+import wave
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+
+
+# -- interaction -----------------------------------------------------------
+
+def test_shell_interact_uses_workflow_namespace(monkeypatch):
+    from veles_tpu.interaction import Shell
+    wf = DummyWorkflow()
+    shell = Shell(wf)
+    seen = {}
+
+    class FakeEmbed(object):
+        def __call__(self, local_ns=None):
+            seen.update(local_ns or {})
+
+    shell.shell_ = FakeEmbed()
+    shell.interact(extra_locals={"extra": 42})
+    assert seen["workflow"] is wf
+    assert isinstance(seen["units"], list)
+    assert seen["extra"] == 42
+
+
+def test_shell_run_noop_without_tty(monkeypatch):
+    from veles_tpu.interaction import Shell
+    shell = Shell(DummyWorkflow())
+    shell.shell_ = object()
+    monkeypatch.setattr(sys, "stdin", io.StringIO(""))  # not a tty
+    shell.run()  # must not raise or block
+
+
+def test_shell_interact_next_run_flag():
+    from veles_tpu.interaction import Shell
+    shell = Shell(DummyWorkflow())
+    calls = []
+    shell.interact = lambda *a, **k: calls.append(1)
+    shell.interact_next_run = True
+    shell.run()
+    assert calls == [1]
+    assert not shell.interact_next_run
+
+
+def test_print_thread_stacks_lists_main_thread():
+    from veles_tpu.interaction import print_thread_stacks
+    buf = io.StringIO()
+    print_thread_stacks(file=buf)
+    assert "MainThread" in buf.getvalue()
+
+
+def test_debug_deadlocks_flags_non_daemon_thread():
+    import threading
+    from veles_tpu.interaction import debug_deadlocks
+    gate = threading.Event()
+    thr = threading.Thread(target=gate.wait, name="suspicious-worker")
+    thr.start()
+    try:
+        buf = io.StringIO()
+        suspects = debug_deadlocks(file=buf)
+        assert thr in suspects
+        assert "suspicious-worker" in buf.getvalue()
+    finally:
+        gate.set()
+        thr.join()
+    assert debug_deadlocks(file=io.StringIO()) == []
+
+
+# -- scripts ---------------------------------------------------------------
+
+def test_generate_frontend_catalog(tmp_path):
+    from veles_tpu.scripts.generate_frontend import generate
+    doc = generate(str(tmp_path / "catalog.json"))
+    assert "RESTfulAPI" in doc["units"]
+    assert "SnapshotterToFile" in doc["units"]
+    unit = doc["units"]["RESTfulAPI"]
+    assert unit["module"] == "veles_tpu.restful_api" and unit["id"]
+    flags = {f for arg in doc["arguments"] for f in arg["flags"]}
+    assert "--test" in flags
+    on_disk = json.loads((tmp_path / "catalog.json").read_text())
+    assert set(on_disk) == {"units", "arguments"}
+
+
+def _snap_provider():
+    """Module-level (picklable) dataset provider for snapshot tests."""
+    rng = numpy.random.RandomState(1)
+    return (rng.rand(40, 6, 6).astype(numpy.float32),
+            rng.randint(0, 10, 40).astype(numpy.int32),
+            rng.rand(10, 6, 6).astype(numpy.float32),
+            rng.randint(0, 10, 10).astype(numpy.int32))
+
+
+def test_compare_snapshots_end_to_end(tmp_path):
+    from veles_tpu import prng
+    from veles_tpu.backends import Device
+    from veles_tpu.models.mnist import MnistWorkflow
+    from veles_tpu.scripts.compare_snapshots import (compare, format_table,
+                                                     main)
+    from veles_tpu.snapshotter import dump_workflow
+
+    def build(extra_epochs):
+        prng.get().seed(7)
+        prng.get("loader").seed(8)
+        wf = MnistWorkflow(provider=_snap_provider, layers=(8,),
+                           minibatch_size=10, max_epochs=1 + extra_epochs)
+        wf.initialize(device=Device(backend="cpu"))
+        wf.run()
+        return wf
+
+    paths = []
+    for i in range(2):
+        wf = build(i)
+        path = tmp_path / ("snap%d.pickle" % i)
+        path.write_bytes(dump_workflow(wf))
+        paths.append(str(path))
+    diffs = compare(paths[0], paths[1])
+    assert diffs, "weights after 1 vs 2 epochs must differ"
+    assert any(rel > 0 for _, _, _, rel, _, _ in diffs)
+    table = format_table(diffs)
+    assert "Avg Rel Diff" in table
+    # identical snapshots → all-zero diffs
+    same = compare(paths[0], paths[0])
+    assert all(rel == 0 and avg == 0 and mx == 0
+               for _, _, _, rel, avg, mx in same)
+    assert main(["-q", paths[0], paths[0]]) == 0
+
+
+# -- sound loader ----------------------------------------------------------
+
+def _write_wav(path, freq, n=800, rate=8000, width=2, channels=1):
+    t = numpy.arange(n) / rate
+    signal = numpy.sin(2 * numpy.pi * freq * t)
+    if channels == 2:
+        signal = numpy.stack([signal, -signal], axis=1)
+    pcm = (signal * 32000).astype("<i2")
+    with wave.open(str(path), "wb") as f:
+        f.setnchannels(channels)
+        f.setsampwidth(width)
+        f.setframerate(rate)
+        f.writeframes(pcm.tobytes())
+
+
+def test_decode_sound_wav(tmp_path):
+    from veles_tpu.loader.sound import decode_sound
+    _write_wav(tmp_path / "a.wav", freq=440)
+    data, rate = decode_sound(str(tmp_path / "a.wav"))
+    assert rate == 8000 and data.shape == (800,)
+    assert data.dtype == numpy.float32
+    assert 0.9 < numpy.abs(data).max() <= 1.0
+
+
+def test_decode_sound_stereo_mixdown(tmp_path):
+    from veles_tpu.loader.sound import decode_sound
+    _write_wav(tmp_path / "s.wav", freq=440, channels=2)
+    data, _ = decode_sound(str(tmp_path / "s.wav"))
+    # L = -R → mono mixdown cancels to ~0
+    assert numpy.abs(data).max() < 1e-3
+
+
+def test_snd_file_loader_directory_tree(tmp_path):
+    from veles_tpu.loader.sound import SndFileLoader
+    for klass, n1, n2 in (("train", 6, 4), ("valid", 2, 2)):
+        for label, freq, count in (("la", 440, n1), ("si", 494, n2)):
+            d = tmp_path / klass / label
+            d.mkdir(parents=True)
+            for i in range(count):
+                _write_wav(d / ("%02d.wav" % i), freq=freq, n=700 + 10 * i)
+    loader = SndFileLoader(
+        DummyWorkflow(),
+        train_paths=(str(tmp_path / "train"),),
+        validation_paths=(str(tmp_path / "valid"),),
+        samples=750, minibatch_size=5)
+    loader.initialize()
+    assert loader.class_lengths == [0, 4, 10]
+    assert loader.n_classes == 2
+    assert loader.original_data.mem.shape == (14, 750)
+    assert loader.sample_rate == 8000
+    labels = loader.original_labels.mem
+    assert set(labels.tolist()) == {0, 1}
+
+
+def test_snd_file_loader_rejects_mixed_rates(tmp_path):
+    from veles_tpu.loader.sound import SndFileLoader
+    d = tmp_path / "train" / "x"
+    d.mkdir(parents=True)
+    _write_wav(d / "a.wav", freq=440, rate=8000)
+    _write_wav(d / "b.wav", freq=440, rate=16000)
+    loader = SndFileLoader(DummyWorkflow(),
+                           train_paths=(str(tmp_path / "train"),),
+                           minibatch_size=2)
+    with pytest.raises(ValueError, match="rate"):
+        loader.initialize()
+
+
+# -- hdfs loader (gated) ---------------------------------------------------
+
+def test_hdfs_loader_gated_without_namenode():
+    from veles_tpu.loader.hdfs import HDFSLoader
+    loader = HDFSLoader(DummyWorkflow(), train_path="/data/train.pickle",
+                        minibatch_size=4)
+    with pytest.raises(RuntimeError, match="namenode"):
+        loader.load_dataset()
+
+
+def test_hdfs_loader_reads_webhdfs(tmp_path):
+    """Drive the WebHDFS path against a local stub namenode."""
+    import pickle
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+    from veles_tpu.loader.hdfs import HDFSLoader
+
+    rng = numpy.random.RandomState(0)
+    blobs = {
+        "/data/train.pickle": pickle.dumps(
+            (rng.rand(8, 3).astype(numpy.float32),
+             rng.randint(0, 2, 8).astype(numpy.int32))),
+        "/data/valid.pickle": pickle.dumps(
+            (rng.rand(4, 3).astype(numpy.float32),
+             rng.randint(0, 2, 4).astype(numpy.int32))),
+    }
+
+    class Stub(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            path = path[len("/webhdfs/v1"):]
+            blob = blobs.get(path)
+            if blob is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+    server = HTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        loader = HDFSLoader(
+            DummyWorkflow(),
+            namenode="127.0.0.1:%d" % server.server_address[1],
+            train_path="/data/train.pickle",
+            validation_path="/data/valid.pickle", minibatch_size=4)
+        loader.initialize()
+        assert loader.class_lengths == [0, 4, 8]
+        assert loader.original_data.mem.shape == (12, 3)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# -- CLI smoke -------------------------------------------------------------
+
+def test_scripts_run_as_modules():
+    out = subprocess.run(
+        [sys.executable, "-m", "veles_tpu.scripts.generate_frontend"],
+        capture_output=True, text=True, timeout=240, cwd="/root/repo")
+    assert out.returncode == 0
+    doc = json.loads(out.stdout)
+    assert "units" in doc and "arguments" in doc

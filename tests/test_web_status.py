@@ -1,0 +1,167 @@
+"""Web status dashboard (reference: tests/test_web_status.py)."""
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from veles_tpu import web_status
+from veles_tpu.web_status import (GARBAGE_TIMEOUT, WebStatusLogHandler,
+                                  WebStatusServer)
+
+
+def _post(address, path, payload):
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d%s" % (address[1], path),
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(address, path):
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (address[1], path), timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture
+def server():
+    srv = WebStatusServer(host="127.0.0.1", port=0).start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def test_update_then_workflows_query(server):
+    status, reply = _post(server.address, "/update", {
+        "id": "master-1", "name": "mnist", "mode": "master",
+        "master": "host:5000", "time": 12.5, "slaves": {"s1": {}},
+        "units": 9, "stopped": False})
+    assert status == 200
+    status, reply = _post(server.address, "/service", {
+        "request": "workflows", "args": ["name", "slaves", "units"]})
+    assert status == 200
+    wf = reply["result"]["master-1"]
+    assert wf == {"name": "mnist", "slaves": {"s1": {}}, "units": 9}
+
+
+def test_silent_masters_are_garbage_collected(server):
+    _post(server.address, "/update", {"id": "old", "name": "x"})
+    server.masters["old"]["last_update"] = time.time() - GARBAGE_TIMEOUT - 1
+    _post(server.address, "/update", {"id": "live", "name": "y"})
+    status, reply = _post(server.address, "/service",
+                          {"request": "workflows", "args": ["name"]})
+    assert set(reply["result"]) == {"live"}
+    assert "old" not in server.masters
+
+
+def test_logs_and_events_queries(server):
+    _post(server.address, "/logs", {"logs": [
+        {"session": "s1", "levelname": "INFO", "message": "hello",
+         "created": 100.0},
+        {"session": "s1", "levelname": "ERROR", "message": "boom",
+         "created": 200.0},
+        {"session": "s2", "levelname": "ERROR", "message": "other",
+         "created": 300.0}]})
+    _post(server.address, "/events", {"events": [
+        {"session": "s1", "name": "run", "type": "begin", "time": 1.0},
+        {"session": "s1", "name": "run", "type": "end", "time": 2.0}]})
+    status, reply = _post(server.address, "/service", {
+        "request": "logs", "find": {"session": "s1", "levelname": "ERROR"}})
+    assert [r["message"] for r in reply["result"]] == ["boom"]
+    status, reply = _post(server.address, "/service", {
+        "request": "logs", "find": {"created": {"$gte": 150.0,
+                                                "$lte": 250.0}}})
+    assert [r["message"] for r in reply["result"]] == ["boom"]
+    status, reply = _post(server.address, "/service", {
+        "request": "events", "find": {"type": "end"}})
+    assert len(reply["result"]) == 1
+    # unknown request type → result None (reference behavior)
+    status, reply = _post(server.address, "/service", {"request": "nope"})
+    assert status == 200 and reply["result"] is None
+
+
+def test_malformed_requests(server):
+    status, reply = _post(server.address, "/service", {"no_request": 1})
+    assert status == 400 and "error" in reply
+    status, reply = _post(server.address, "/service",
+                          {"request": "logs"})  # no find
+    assert status == 400
+    status, reply = _post(server.address, "/nope", {})
+    assert status == 404
+
+
+def test_html_pages(server):
+    status, page = _get(server.address, "/status.html")
+    assert status == 200 and "veles_tpu workflows" in page
+    status, page = _get(server.address, "/")
+    assert status == 200 and "veles_tpu workflows" in page
+    status, page = _get(server.address, "/logs.html")
+    assert status == 200 and "logs" in page
+
+
+def test_log_handler_forwards_records(server):
+    handler = WebStatusLogHandler(
+        address=("127.0.0.1", server.port), session="sess-1", node="here",
+        flush_interval=0.05)
+    logger = logging.getLogger("test-web-status-forward")
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        logger.info("forwarded %d", 42)
+        logger.error("bad thing")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            _, reply = _post(server.address, "/service", {
+                "request": "logs", "find": {"session": "sess-1"}})
+            if len(reply["result"]) >= 2:
+                break
+            time.sleep(0.05)
+        msgs = {r["message"] for r in reply["result"]}
+        assert "forwarded 42" in msgs and "bad thing" in msgs
+        levels = {r["levelname"] for r in reply["result"]}
+        assert levels == {"INFO", "ERROR"}
+        assert all(r["node"] == "here" for r in reply["result"])
+    finally:
+        logger.removeHandler(handler)
+        handler.close()
+
+
+def test_launcher_notifier_posts_to_dashboard(server):
+    """The Launcher's --web-status loop must land in self.masters."""
+    from veles_tpu.config import root
+    from veles_tpu.launcher import Launcher
+    saved = (root.common.web.host, root.common.web.port,
+             root.common.web.notification_interval)
+    root.common.web.update({"host": "127.0.0.1", "port": server.port,
+                            "notification_interval": 0.05})
+    launcher = Launcher(web_status=True)
+
+    class _FakeWorkflow(object):
+        name = "fake"
+
+        def __len__(self):
+            return 3
+
+    launcher.workflow = _FakeWorkflow()
+    launcher.start_time = time.time()
+    launcher._start_status_notifier()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and launcher.id not in server.masters:
+            time.sleep(0.05)
+        assert launcher.id in server.masters
+        master = server.masters[launcher.id]
+        assert master["name"] == "fake" and master["units"] == 3
+    finally:
+        launcher._finished.set()
+        root.common.web.update({"host": saved[0], "port": saved[1],
+                                "notification_interval": saved[2]})

@@ -1,0 +1,127 @@
+"""Mid-workflow interaction (re-designs ``veles/interaction.py:49``).
+
+:class:`Shell` embeds an IPython console inside a running workflow:
+link it into the loop and press ``i``+Enter while training — the next
+time the unit fires it drops into a console with ``workflow`` and
+``units`` in scope. Non-TTY runs are no-ops, so the unit is safe to
+leave wired in production configs.
+
+The manhole/SIGUSR debugging of the reference's thread pool
+(``veles/thread_pool.py:520-568``) survives as
+:func:`install_stack_dump_handler` (``SIGUSR1`` → all thread stacks to
+stderr) and :func:`debug_deadlocks` (warn at exit when extra threads
+are still alive).
+"""
+
+import select
+import signal
+import sys
+import threading
+import traceback
+
+from veles_tpu.distributable import TriviallyDistributable
+from veles_tpu.units import Unit
+
+
+class Shell(Unit, TriviallyDistributable):
+    """Runs embedded IPython when the user asks for it."""
+
+    BANNER1 = "\nveles_tpu interactive console"
+    BANNER2 = "Type in 'workflow' or 'units' to start"
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("view_group", "SERVICE")
+        super(Shell, self).__init__(workflow, **kwargs)
+        #: force-interact on the next run() regardless of stdin (tests,
+        #: programmatic use)
+        self.interact_next_run = False
+
+    def init_unpickled(self):
+        super(Shell, self).init_unpickled()
+        self.shell_ = None
+
+    @property
+    def interactive(self):
+        launcher = self.launcher
+        return bool(launcher is not None and
+                    getattr(launcher, "is_interactive", False))
+
+    def initialize(self, **kwargs):
+        if self.interactive:
+            return  # already inside a REPL: embedding would recurse
+        try:
+            from IPython.terminal.embed import InteractiveShellEmbed
+        except ImportError:
+            self.warning("IPython is not available; Shell disabled")
+            return
+        self.shell_ = InteractiveShellEmbed(banner1=self.BANNER1,
+                                            banner2=self.BANNER2)
+
+    def interact(self, extra_locals=None):
+        workflow = self.workflow                      # noqa: F841
+        units = list(self.workflow.units)             # noqa: F841
+        ns = dict(locals())
+        ns.update(extra_locals or {})
+        if self.shell_ is None:
+            self.warning("no shell to interact with")
+            return
+        self.shell_(local_ns=ns)
+
+    def run(self):
+        if self.interact_next_run:
+            self.interact_next_run = False
+            self.interact()
+            return
+        if self.interactive or self.shell_ is None or not sys.stdin.isatty():
+            return
+        # one non-blocking peek at stdin: 'i' + Enter opens the console
+        ready, _, _ = select.select([sys.stdin], [], [], 0)
+        if ready and sys.stdin.readline()[:1] == "i":
+            self.interact()
+
+
+def print_thread_stacks(file=None):
+    """Dump every live thread's stack (``thread_pool.py:536-546``)."""
+    file = file or sys.stderr
+    tmap = {thr.ident: thr.name for thr in threading.enumerate()}
+    for tid, stack in sys._current_frames().items():
+        print("-" * 80, file=file)
+        print("Thread #%d (%s):" % (tid, tmap.get(tid, "<unknown>")),
+              file=file)
+        traceback.print_stack(stack, file=file)
+    file.flush()
+
+
+def install_stack_dump_handler(signum=None):
+    """SIGUSR1 → stack dump on demand (``thread_pool.py:520-525``).
+
+    Only callable from the main thread (signal module restriction);
+    returns the previous handler.
+    """
+    if signum is None:
+        signum = getattr(signal, "SIGUSR1", None)
+        if signum is None:  # pragma: no cover - non-POSIX
+            return None
+    return signal.signal(signum, lambda sig, frame: print_thread_stacks())
+
+
+#: thread names that legitimately outlive the run
+KNOWN_RUNNING_THREADS = (
+    "MainThread", "pydevd", "status-notifier", "web-status",
+    "graphics", "-http", "-accept", "heartbeat",
+)
+
+
+def debug_deadlocks(file=None):
+    """Warn + dump stacks if suspicious threads are still alive
+    (``thread_pool.py:552-568``). Returns the suspects."""
+    suspects = [
+        thr for thr in threading.enumerate()
+        if thr.is_alive() and not thr.daemon and
+        not any(name in thr.name for name in KNOWN_RUNNING_THREADS)]
+    if suspects:
+        print("Possible deadlock: %d non-daemon threads still alive: %s"
+              % (len(suspects), [t.name for t in suspects]),
+              file=file or sys.stderr)
+        print_thread_stacks(file=file)
+    return suspects

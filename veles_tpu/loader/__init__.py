@@ -12,6 +12,7 @@ from veles_tpu.loader.fullbatch import (FullBatchLoader,  # noqa: F401
                                         FullBatchLoaderMSE)
 from veles_tpu.loader.ensemble import EnsembleLoader  # noqa: F401
 from veles_tpu.loader.hdf5 import HDF5Loader  # noqa: F401
+from veles_tpu.loader.hdfs import HDFSLoader  # noqa: F401
 from veles_tpu.loader.image import (AutoLabelFileImageLoader,  # noqa: F401
                                     FileImageLoader, ImageLoaderMSE)
 from veles_tpu.loader.interactive import (InteractiveLoader,  # noqa: F401
@@ -20,3 +21,4 @@ from veles_tpu.loader.pickles import PicklesLoader  # noqa: F401
 from veles_tpu.loader.restful import RestfulLoader  # noqa: F401
 from veles_tpu.loader.saver import (MinibatchesLoader,  # noqa: F401
                                     MinibatchesSaver)
+from veles_tpu.loader.sound import SndFileLoader  # noqa: F401

@@ -1,0 +1,87 @@
+"""HDFS-backed loader (gated re-design of ``veles/loader/hdfs_loader.py``).
+
+The reference streamed minibatches out of Hadoop HDFS via the ``hdfs``
+/ Mastodon bridge. Neither Hadoop client libraries nor a cluster exist
+in this environment, so this is a *gated* implementation: it speaks
+WebHDFS over plain HTTP (stdlib only — no extra dependency) when a
+namenode is reachable, and raises a clear error otherwise. The loader
+surface matches :class:`~veles_tpu.loader.pickles.PicklesLoader`:
+test/validation/train object paths, each a pickled ``(data, labels)``
+tuple, fetched over WebHDFS and assembled into a device-resident full
+batch.
+"""
+
+import json
+import pickle
+import urllib.error
+import urllib.request
+
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+
+class WebHDFSClient(object):
+    """Minimal WebHDFS reader: OPEN + GETFILESTATUS."""
+
+    def __init__(self, namenode, user=None, timeout=30.0):
+        if "://" not in namenode:
+            namenode = "http://" + namenode
+        self.base = namenode.rstrip("/") + "/webhdfs/v1"
+        self.user = user
+        self.timeout = timeout
+
+    def _url(self, path, op):
+        if not path.startswith("/"):
+            path = "/" + path
+        url = "%s%s?op=%s" % (self.base, path, op)
+        if self.user:
+            url += "&user.name=" + self.user
+        return url
+
+    def status(self, path):
+        with urllib.request.urlopen(self._url(path, "GETFILESTATUS"),
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read())["FileStatus"]
+
+    def read(self, path):
+        with urllib.request.urlopen(self._url(path, "OPEN"),
+                                    timeout=self.timeout) as resp:
+            return resp.read()
+
+
+class HDFSLoader(FullBatchLoader):
+    """Pickled class files fetched from HDFS (WebHDFS REST)."""
+
+    MAPPING = "hdfs"
+
+    def __init__(self, workflow, **kwargs):
+        self.namenode = kwargs.pop("namenode", None)
+        self.user = kwargs.pop("user", None)
+        self.test_path = kwargs.pop("test_path", None)
+        self.validation_path = kwargs.pop("validation_path", None)
+        self.train_path = kwargs.pop("train_path", None)
+        super(HDFSLoader, self).__init__(workflow, **kwargs)
+        self.client = None
+
+    def load_dataset(self):
+        if not self.namenode:
+            raise RuntimeError(
+                "%s needs a namenode=host:port (WebHDFS); no Hadoop "
+                "client libraries are bundled — this loader is gated on "
+                "a reachable WebHDFS endpoint" % self.name)
+        self.client = WebHDFSClient(self.namenode, user=self.user)
+
+        def reader(path):
+            try:
+                blob = self.client.read(path)
+            except (urllib.error.URLError, OSError) as e:
+                raise RuntimeError(
+                    "%s: cannot fetch %s from %s: %s" %
+                    (self.name, path, self.namenode, e))
+            obj = pickle.loads(blob)
+            if isinstance(obj, tuple) and len(obj) == 2:
+                return obj
+            return obj, None
+
+        self.load_class_files(
+            (self.test_path, self.validation_path, self.train_path),
+            reader, kind="HDFS")

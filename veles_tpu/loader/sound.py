@@ -1,0 +1,126 @@
+"""Audio file loaders (re-design ``veles/loader/libsndfile_loader.py``).
+
+The reference wrapped libsndfile through ctypes; that dependency is not
+in this image, so decoding goes through :mod:`scipy.io.wavfile` (WAV of
+any PCM width) with a gated ``soundfile`` path for FLAC/OGG when that
+package exists. The loader surface matches the file-image loaders:
+test/validation/train path lists scanned into a device-resident full
+batch, labels taken from the immediate parent directory name.
+
+Samples are normalized to float32 in [-1, 1], mixed down to mono, and
+either truncated or zero-padded to ``samples`` frames so the batch
+stacks (the reference raised on >2 channels; we mix instead — an
+explicit TPU-friendly choice: fixed shapes).
+"""
+
+import os
+
+import numpy
+
+from veles_tpu.loader.base import Loader  # noqa: F401 (registry import)
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+#: extensions decodable without optional deps
+WAV_EXTENSIONS = (".wav", ".wave")
+#: extensions needing the optional ``soundfile`` package
+SOUNDFILE_EXTENSIONS = (".flac", ".ogg", ".aiff", ".aif")
+
+
+def decode_sound(path):
+    """-> (float32 mono array in [-1, 1], sample_rate)."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext in WAV_EXTENSIONS:
+        from scipy.io import wavfile
+        rate, data = wavfile.read(path)
+        if data.dtype.kind == "i":
+            data = data.astype(numpy.float32) / numpy.iinfo(data.dtype).max
+        elif data.dtype.kind == "u":  # u8 wav: offset binary
+            info = numpy.iinfo(data.dtype)
+            data = (data.astype(numpy.float32) - (info.max + 1) / 2) \
+                / ((info.max + 1) / 2)
+        else:
+            data = data.astype(numpy.float32)
+    elif ext in SOUNDFILE_EXTENSIONS:
+        try:
+            import soundfile
+        except ImportError:
+            raise ImportError(
+                "decoding %s needs the optional 'soundfile' package "
+                "(only PCM WAV is supported without it)" % path)
+        data, rate = soundfile.read(path, dtype="float32")
+    else:
+        raise ValueError("unsupported audio format: %s" % path)
+    if data.ndim > 1:  # mix down to mono
+        data = data.mean(axis=1)
+    return numpy.ascontiguousarray(data, numpy.float32), int(rate)
+
+
+class SndFileLoader(FullBatchLoader):
+    """Directory-tree audio loader; labels = parent directory names."""
+
+    MAPPING = "sound_file"
+
+    def __init__(self, workflow, **kwargs):
+        self.test_paths = tuple(kwargs.pop("test_paths", ()))
+        self.validation_paths = tuple(kwargs.pop("validation_paths", ()))
+        self.train_paths = tuple(kwargs.pop("train_paths", ()))
+        #: fixed number of frames per sample (pad/truncate target);
+        #: None = infer from the first file
+        self.samples = kwargs.pop("samples", None)
+        super(SndFileLoader, self).__init__(workflow, **kwargs)
+        self.labels_mapping = {}
+        self.sample_rate = None
+
+    def _scan_class(self, paths):
+        found = []
+        exts = WAV_EXTENSIONS + SOUNDFILE_EXTENSIONS
+        for base in paths:
+            if os.path.isfile(base):
+                found.append((base, os.path.basename(
+                    os.path.dirname(os.path.abspath(base)))))
+                continue
+            for dirpath, dirnames, filenames in sorted(os.walk(base)):
+                dirnames.sort()
+                for fn in sorted(filenames):
+                    if os.path.splitext(fn)[1].lower() in exts:
+                        found.append((os.path.join(dirpath, fn),
+                                      os.path.basename(dirpath)))
+        return found
+
+    def _fit(self, data):
+        if len(data) >= self.samples:
+            return data[:self.samples]
+        out = numpy.zeros(self.samples, numpy.float32)
+        out[:len(data)] = data
+        return out
+
+    def load_dataset(self):
+        per_class = [self._scan_class(p) for p in
+                     (self.test_paths, self.validation_paths,
+                      self.train_paths)]
+        if not any(per_class):
+            raise ValueError("%s found no audio files" % self.name)
+        names = sorted({label for pairs in per_class for _, label in pairs})
+        self.labels_mapping = {name: i for i, name in enumerate(names)}
+        data, labels = [], []
+        for klass, pairs in enumerate(per_class):
+            for path, label in pairs:
+                sound, rate = decode_sound(path)
+                if self.sample_rate is None:
+                    self.sample_rate = rate
+                elif rate != self.sample_rate:
+                    raise ValueError(
+                        "%s: %s has rate %d, expected %d (resampling is "
+                        "out of scope — preprocess the dataset)" %
+                        (self.name, path, rate, self.sample_rate))
+                if self.samples is None:
+                    self.samples = len(sound)
+                data.append(self._fit(sound))
+                labels.append(self.labels_mapping[label])
+            self.class_lengths[klass] = len(pairs)
+        self.original_data.reset(numpy.stack(data))
+        self.original_labels.reset(numpy.asarray(labels, numpy.int32))
+
+    @property
+    def n_classes(self):
+        return len(self.labels_mapping)

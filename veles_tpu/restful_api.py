@@ -158,14 +158,18 @@ class RESTfulAPI(Unit, TriviallyDistributable):
 
     # -- HTTP side ---------------------------------------------------------
 
-    def fail(self, handler, message, code=400):
-        self.warning(message)
-        body = json.dumps({"error": message}).encode("utf-8")
+    @staticmethod
+    def _respond(handler, code, payload):
+        body = json.dumps(payload, cls=_NumpyJSONEncoder).encode("utf-8")
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(body)))
         handler.end_headers()
         handler.wfile.write(body)
+
+    def fail(self, handler, message, code=400):
+        self.warning(message)
+        self._respond(handler, code, {"error": message})
 
     def _decode_base64(self, handler, request, input_obj):
         """The base64 codec: needs "shape" and "type" attributes."""
@@ -214,6 +218,13 @@ class RESTfulAPI(Unit, TriviallyDistributable):
         # drain the body before ANY fail path: on a keep-alive
         # connection unread body bytes would be parsed as the next
         # request line, corrupting the client's following request
+        if handler.headers.get("Transfer-Encoding") and \
+                "Content-Length" not in handler.headers:
+            # chunked bodies can't be drained by length; close instead
+            # of letting the chunk bytes corrupt the next request
+            handler.close_connection = True
+            self.fail(handler, "Content-Length required", code=411)
+            return
         try:
             length = int(handler.headers.get("Content-Length", 0))
             raw = handler.rfile.read(length)
@@ -260,13 +271,22 @@ class RESTfulAPI(Unit, TriviallyDistributable):
         # feed + pending append under one lock: the loader queue and the
         # response FIFO must agree on ordering across HTTP threads
         feed_error = None
+        stopped = False
         with self._pending_lock_:
-            try:
-                self.feed(data)
-            except Exception as e:
-                feed_error = str(e) or type(e).__name__
+            if self._server_ is None:
+                # stop() already drained _pending_; feeding now would
+                # block this client for the whole response_timeout
+                stopped = True
             else:
-                self._pending_.append(slot)
+                try:
+                    self.feed(data)
+                except Exception as e:
+                    feed_error = str(e) or type(e).__name__
+                else:
+                    self._pending_.append(slot)
+        if stopped:
+            self.fail(handler, "service stopped", code=503)
+            return
         if feed_error is not None:
             self.fail(handler, "Invalid input value: %s" % feed_error)
             return
@@ -283,10 +303,4 @@ class RESTfulAPI(Unit, TriviallyDistributable):
         if slot["error"] is not None:
             self.fail(handler, slot["error"], code=500)
             return
-        body = json.dumps({"result": slot["result"]},
-                          cls=_NumpyJSONEncoder).encode("utf-8")
-        handler.send_response(200)
-        handler.send_header("Content-Type", "application/json")
-        handler.send_header("Content-Length", str(len(body)))
-        handler.end_headers()
-        handler.wfile.write(body)
+        self._respond(handler, 200, {"result": slot["result"]})

@@ -1,0 +1,2 @@
+"""Operator scripts (re-designs ``veles/scripts/``): compare_snapshots,
+generate_frontend. Run as ``python -m veles_tpu.scripts.<name>``."""

@@ -1,0 +1,114 @@
+"""Compare two workflow snapshots array-by-array.
+
+Re-designs ``veles/scripts/compare_snapshots.py``: loads both
+snapshots, walks units in dependency order, diffs every
+:class:`~veles_tpu.memory.Array` attribute and prints a sortable table
+of average-relative / average / max absolute differences. Useful for
+answering "did this refactor change the numerics" and "how far apart
+are these two training runs".
+"""
+
+import argparse
+import logging
+import sys
+
+import numpy
+
+SORT_CHOICES = ("dep", "unit", "attr", "avgreldiff", "avgdiff", "maxdiff")
+SORT_CHOICES_MAP = {k: i for i, k in enumerate(SORT_CHOICES)}
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="Compare snapshots")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="do not print logs")
+    parser.add_argument("-s", "--sort", choices=SORT_CHOICES, nargs="*",
+                        default=["dep", "avgreldiff"],
+                        help="sort by these fields, in order")
+    parser.add_argument("first", help="path to the first snapshot")
+    parser.add_argument("second", help="path to the second snapshot")
+    return parser.parse_args(argv)
+
+
+def load_snapshot(path):
+    from veles_tpu.snapshotter import SnapshotterToFile
+    return SnapshotterToFile.import_(path)
+
+
+def get_diffs(first_units, second_units):
+    """Yield (dep_index, unit, attr, avgreldiff, avgdiff, maxdiff)."""
+    from veles_tpu.memory import Array
+    for index, (first_unit, second_unit) in enumerate(
+            zip(first_units, second_units)):
+        for key, first_val in sorted(first_unit.__dict__.items()):
+            if not isinstance(first_val, Array):
+                continue
+            second_val = getattr(second_unit, key, None)
+            if not isinstance(second_val, Array):
+                continue
+            if first_val.mem is None or second_val.mem is None:
+                continue
+            a = numpy.asarray(first_val.mem, numpy.float64)
+            b = numpy.asarray(second_val.mem, numpy.float64)
+            if a.shape != b.shape:
+                yield (index, first_unit.name, key,
+                       float("inf"), float("inf"), float("inf"))
+                continue
+            diff = a - b
+            avg_diff = float(numpy.mean(numpy.abs(diff)))
+            val_sum = a + b
+            nz = numpy.nonzero(val_sum)
+            rel = 2 * (diff[nz] / val_sum[nz])
+            if rel.size > 0:
+                avg_rel_diff = float(numpy.mean(numpy.abs(rel)))
+            else:
+                avg_rel_diff = float(not (diff == 0).all())
+            max_diff = float(numpy.max(numpy.abs(diff))) if diff.size \
+                else 0.0
+            yield (index, first_unit.name, key,
+                   avg_rel_diff, avg_diff, max_diff)
+
+
+def sort_diffs(diffs, sorting):
+    return sorted(diffs, key=lambda rec: tuple(
+        rec[SORT_CHOICES_MAP[sk]] for sk in sorting))
+
+
+def format_table(diffs):
+    """Plain-text table (the reference used bundled prettytable)."""
+    headers = ("Unit", "Attribute", "Avg Rel Diff", "Avg Diff", "Max Diff")
+    rows = [(name, attr, "%.6g" % rel, "%.6g" % avg, "%.6g" % mx)
+            for _, name, attr, rel, avg, mx in diffs]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    sep = "+-" + "-+-".join("-" * w for w in widths) + "-+"
+    out = [sep, "| " + " | ".join(
+        h.ljust(w) for h, w in zip(headers, widths)) + " |", sep]
+    for row in rows:
+        out.append("| " + " | ".join(
+            c.ljust(w) for c, w in zip(row, widths)) + " |")
+    out.append(sep)
+    return "\n".join(out)
+
+
+def compare(first_path, second_path, sorting=("dep", "avgreldiff")):
+    first = load_snapshot(first_path)
+    second = load_snapshot(second_path)
+    if type(first) is not type(second) or \
+            first.checksum != second.checksum:
+        raise ValueError("Cannot compare different workflows")
+    return sort_diffs(get_diffs(first.units_in_dependency_order,
+                                second.units_in_dependency_order), sorting)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    logging.basicConfig(
+        level=logging.WARNING if args.quiet else logging.INFO)
+    diffs = compare(args.first, args.second, args.sort)
+    print(format_table(diffs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

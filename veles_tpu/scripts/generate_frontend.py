@@ -1,0 +1,86 @@
+"""Dump the unit registry + aggregated CLI for the web frontend.
+
+Re-designs ``veles/scripts/generate_frontend.py``: walks
+:class:`~veles_tpu.unit_registry.UnitRegistry` and the aggregated
+argparse tree (``veles_tpu/cmdline.py``) and emits a JSON document the
+command-composer UI consumes — every unit type (name, module, docstring,
+stable ``__id__``) and every CLI flag (name, default, choices, help).
+"""
+
+import argparse
+import json
+import sys
+
+
+#: modules whose import populates the unit registry — the catalog must
+#: cover the whole shipped unit surface, not just what happens to be
+#: imported already
+_UNIT_MODULES = (
+    "veles_tpu.plumbing", "veles_tpu.loader", "veles_tpu.nn",
+    "veles_tpu.snapshotter", "veles_tpu.plotting_units",
+    "veles_tpu.restful_api", "veles_tpu.interaction",
+    "veles_tpu.downloader", "veles_tpu.avatar", "veles_tpu.input_joiner",
+    "veles_tpu.mean_disp_normalizer", "veles_tpu.zmq_loader",
+    "veles_tpu.genetics", "veles_tpu.ensemble", "veles_tpu.launcher",
+)
+
+
+def describe_units():
+    import importlib
+    for mod in _UNIT_MODULES:
+        importlib.import_module(mod)
+    from veles_tpu.unit_registry import UnitRegistry
+    units = {}
+    for name, cls in sorted(UnitRegistry.units.items()):
+        units[name] = {
+            "module": cls.__module__,
+            "id": getattr(cls, "__id__", None),
+            "doc": (cls.__doc__ or "").strip().split("\n")[0],
+            "view_group": getattr(cls, "view_group", "WORKER"),
+        }
+    return units
+
+
+def describe_arguments():
+    from veles_tpu.cmdline import init_parser
+    parser = init_parser(prog="veles_tpu")
+    args = []
+    for action in parser._actions:
+        if isinstance(action, argparse._HelpAction):
+            continue
+        args.append({
+            "flags": list(action.option_strings) or [action.dest],
+            "dest": action.dest,
+            "default": action.default
+            if not callable(action.default) else None,
+            "choices": list(action.choices) if action.choices else None,
+            "required": bool(action.required),
+            "help": action.help or "",
+        })
+    return args
+
+
+def generate(path=None):
+    doc = {"units": describe_units(), "arguments": describe_arguments()}
+    text = json.dumps(doc, indent=2, default=str, sort_keys=True)
+    if path:
+        with open(path, "w") as f:
+            f.write(text + "\n")
+    return doc
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Generate the frontend unit/argument catalog")
+    parser.add_argument("-o", "--output", default=None,
+                        help="write JSON here (default: stdout)")
+    args = parser.parse_args(argv)
+    doc = generate(args.output)
+    if not args.output:
+        json.dump(doc, sys.stdout, indent=2, default=str, sort_keys=True)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

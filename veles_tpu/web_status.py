@@ -1,0 +1,346 @@
+"""Web status dashboard (re-designs ``veles/web_status.py:66-265``).
+
+One process serves a fleet of masters: every running Launcher with
+``--web-status`` POSTs periodic status JSON to ``/update`` (see
+``Launcher._start_status_notifier``); browsers/tools POST service
+queries to ``/service``; humans read ``/status.html`` (auto-refreshing
+table of live workflows) and ``/logs.html`` (event timeline).
+
+The reference kept logs/events in MongoDB (motor) and purged old
+sessions periodically; pymongo is not in this environment, so the
+store is in-memory bounded deques with the same query surface — the
+``/service`` protocol (``{"request": "workflows"|"logs"|"events",
+...}``) and the garbage-collection of silent masters
+(``GARBAGE_TIMEOUT``) are preserved. Log duplication to the dashboard
+(the reference's Mongo log handler, ``veles/logger.py:292``) is
+provided by :class:`WebStatusLogHandler`, which POSTs record batches
+to ``/logs``.
+"""
+
+import argparse
+import collections
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+
+GARBAGE_TIMEOUT = 60
+
+_STATUS_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu status</title><style>
+body { font-family: sans-serif; margin: 2em; background: #fafafa; }
+table { border-collapse: collapse; min-width: 60em; }
+th, td { border: 1px solid #ccc; padding: 0.4em 0.8em; text-align: left; }
+th { background: #eee; }
+.dead { color: #999; }
+</style></head><body>
+<h1>veles_tpu workflows</h1>
+<table id="wf"><thead><tr>
+<th>id</th><th>name</th><th>mode</th><th>master</th><th>uptime</th>
+<th>slaves</th><th>units</th><th>stopped</th>
+</tr></thead><tbody></tbody></table>
+<script>
+async function refresh() {
+  const resp = await fetch("/service", {method: "POST",
+    headers: {"Content-Type": "application/json"},
+    body: JSON.stringify({request: "workflows",
+      args: ["name", "mode", "master", "time", "slaves", "units",
+             "stopped"]})});
+  const data = await resp.json();
+  const tbody = document.querySelector("#wf tbody");
+  tbody.innerHTML = "";
+  for (const [mid, wf] of Object.entries(data.result || {})) {
+    const tr = document.createElement("tr");
+    const slaves = wf.slaves ? Object.keys(wf.slaves).length : 0;
+    for (const v of [mid.slice(0, 8), wf.name, wf.mode, wf.master,
+                     Math.round(wf.time) + "s", slaves, wf.units,
+                     wf.stopped]) {
+      const td = document.createElement("td");
+      td.textContent = v === undefined ? "" : String(v);
+      tr.appendChild(td);
+    }
+    tbody.appendChild(tr);
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+_LOGS_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu logs</title><style>
+body { font-family: monospace; margin: 2em; background: #fafafa; }
+table { border-collapse: collapse; width: 100%; }
+th, td { border: 1px solid #ccc; padding: 0.2em 0.6em; text-align: left; }
+th { background: #eee; }
+.ERROR, .CRITICAL { color: #b00; } .WARNING { color: #b70; }
+</style></head><body>
+<h1>veles_tpu logs &amp; events</h1>
+<table id="logs"><thead><tr>
+<th>time</th><th>session</th><th>level</th><th>node</th><th>message</th>
+</tr></thead><tbody></tbody></table>
+<script>
+async function refresh() {
+  const resp = await fetch("/service", {method: "POST",
+    headers: {"Content-Type": "application/json"},
+    body: JSON.stringify({request: "logs", find: {}})});
+  const data = await resp.json();
+  const tbody = document.querySelector("#logs tbody");
+  tbody.innerHTML = "";
+  for (const rec of (data.result || []).slice(-500).reverse()) {
+    const tr = document.createElement("tr");
+    tr.className = rec.levelname || "";
+    for (const v of [new Date((rec.created || 0) * 1000).toISOString(),
+                     (rec.session || "").slice(0, 8), rec.levelname,
+                     rec.node, rec.message]) {
+      const td = document.createElement("td");
+      td.textContent = v === undefined ? "" : String(v);
+      tr.appendChild(td);
+    }
+    tbody.appendChild(tr);
+  }
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+
+def _match(record, query):
+    """MongoDB-lite ``find``: top-level equality (+ $in / $gte / $lte)."""
+    for key, cond in query.items():
+        value = record.get(key)
+        if isinstance(cond, dict):
+            if "$in" in cond and value not in cond["$in"]:
+                return False
+            if "$gte" in cond and not (value is not None
+                                       and value >= cond["$gte"]):
+                return False
+            if "$lte" in cond and not (value is not None
+                                       and value <= cond["$lte"]):
+                return False
+        elif value != cond:
+            return False
+    return True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        self.server.owner.debug("http: " + fmt, *args)
+
+    def _reply(self, body, code=200, ctype="application/json"):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body).encode("utf-8")
+        elif isinstance(body, str):
+            body = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            length = 0
+        raw = self.rfile.read(length)
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return None
+
+    def do_GET(self):
+        if self.path in ("", "/", "/status.html"):
+            self._reply(_STATUS_PAGE, ctype="text/html; charset=utf-8")
+        elif self.path.startswith("/logs.html"):
+            self._reply(_LOGS_PAGE, ctype="text/html; charset=utf-8")
+        else:
+            self._reply({"error": "not found"}, code=404)
+
+    def do_POST(self):
+        data = self._body()
+        if data is None:
+            self._reply({"error": "bad json"}, code=400)
+            return
+        server = self.server.owner
+        try:
+            if self.path == "/update":
+                server.receive_update(data)
+                self._reply({"result": "ok"})
+            elif self.path == "/service":
+                self._reply(server.receive_request(data))
+            elif self.path == "/logs":
+                server.receive_logs(data)
+                self._reply({"result": "ok"})
+            elif self.path == "/events":
+                server.receive_events(data)
+                self._reply({"result": "ok"})
+            else:
+                self._reply({"error": "not found"}, code=404)
+        except (KeyError, TypeError, ValueError) as e:
+            self._reply({"error": str(e) or type(e).__name__}, code=400)
+
+
+class WebStatusServer(Logger):
+    """The dashboard process (``veles/web_status.py:113``)."""
+
+    def __init__(self, host=None, port=None, max_records=100000):
+        super(WebStatusServer, self).__init__()
+        self.masters = {}
+        self.logs = collections.deque(maxlen=max_records)
+        self.events = collections.deque(maxlen=max_records)
+        self._lock = threading.Lock()
+        self._server = ThreadingHTTPServer(
+            (host if host is not None else root.common.web.host,
+             port if port is not None else root.common.web.port),
+            _Handler)
+        self._server.owner = self
+        self._server.daemon_threads = True
+        self.address = self._server.server_address
+        self._thread = None
+
+    @property
+    def port(self):
+        return self.address[1]
+
+    # -- receiving ---------------------------------------------------------
+
+    def receive_update(self, data):
+        """A master's periodic status (``web_status.py:244-251``)."""
+        mid = data["id"]
+        with self._lock:
+            self.masters[mid] = dict(data, last_update=time.time())
+        self.debug("master %s yielded an update", mid)
+
+    def receive_logs(self, data):
+        records = data["logs"] if isinstance(data, dict) else data
+        with self._lock:
+            self.logs.extend(records)
+
+    def receive_events(self, data):
+        records = data["events"] if isinstance(data, dict) else data
+        with self._lock:
+            self.events.extend(records)
+
+    def receive_request(self, data):
+        """The ``/service`` protocol (``web_status.py:197-242``)."""
+        rtype = data["request"]
+        if rtype == "workflows":
+            args = data.get("args", [])
+            ret, garbage = {}, []
+            now = time.time()
+            with self._lock:
+                for mid, master in self.masters.items():
+                    if now - master["last_update"] > GARBAGE_TIMEOUT:
+                        garbage.append(mid)
+                        continue
+                    ret[mid] = {item: master.get(item) for item in args}
+                for mid in garbage:
+                    self.info("removing the garbage collected master %s", mid)
+                    del self.masters[mid]
+            return {"request": rtype, "result": ret}
+        if rtype in ("logs", "events"):
+            query = data.get("find")
+            if query is None:
+                raise ValueError("only 'find' queries are supported")
+            store = self.logs if rtype == "logs" else self.events
+            with self._lock:
+                result = [rec for rec in store if _match(rec, query)]
+            return {"request": rtype, "result": result}
+        return {"request": rtype, "result": None}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self):
+        """Serve until :meth:`stop` (blocking, like the reference)."""
+        self.info("HTTP server is running on %s:%d", *self.address)
+        self._server.serve_forever()
+
+    def start(self):
+        """Serve on a daemon thread (for embedding/tests)."""
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="web-status")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+class WebStatusLogHandler(logging.Handler):
+    """Duplicates log records to the dashboard (the reference's
+    MongoLogHandler, ``veles/logger.py:292``, minus Mongo)."""
+
+    def __init__(self, address=None, session=None, node=None,
+                 flush_interval=1.0):
+        super(WebStatusLogHandler, self).__init__()
+        if address is None:
+            address = (root.common.web.host, root.common.web.port)
+        self.url = "http://%s:%d/logs" % tuple(address)
+        self.session = session
+        self.node = node
+        self._buffer = []
+        self._lock2 = threading.Lock()
+        self._stop = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, args=(flush_interval,), daemon=True,
+            name="web-status-logs")
+        self._flusher.start()
+
+    def emit(self, record):
+        doc = {
+            "session": self.session,
+            "node": self.node,
+            "levelname": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+            "created": record.created,
+        }
+        with self._lock2:
+            self._buffer.append(doc)
+
+    def _flush_loop(self, interval):
+        import urllib.request
+        while not self._stop.wait(interval):
+            with self._lock2:
+                batch, self._buffer = self._buffer, []
+            if not batch:
+                continue
+            try:
+                req = urllib.request.Request(
+                    self.url, data=json.dumps({"logs": batch}).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=2.0)
+            except Exception:
+                with self._lock2:  # keep for the next attempt, bounded
+                    self._buffer = (batch + self._buffer)[-10000:]
+
+    def close(self):
+        self._stop.set()
+        self._flusher.join(timeout=5)
+        super(WebStatusLogHandler, self).close()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="veles_tpu web status dashboard")
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    args = parser.parse_args(argv)
+    server = WebStatusServer(host=args.host, port=args.port)
+    try:
+        server.run()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
