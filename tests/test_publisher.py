@@ -1,0 +1,147 @@
+"""Publishing subsystem (reference: tests/test_publisher.py)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.models.mnist import MnistWorkflow
+from veles_tpu.publishing import Publisher, PublishingBackendRegistry
+
+
+def _provider():
+    rng = numpy.random.RandomState(2)
+    return (rng.rand(30, 6, 6).astype(numpy.float32),
+            rng.randint(0, 10, 30).astype(numpy.int32),
+            rng.rand(10, 6, 6).astype(numpy.float32),
+            rng.randint(0, 10, 10).astype(numpy.int32))
+
+
+@pytest.fixture(scope="module")
+def trained_workflow():
+    from veles_tpu.config import root
+    prng.get().seed(4)
+    prng.get("loader").seed(5)
+    wf = MnistWorkflow(provider=_provider, layers=(8,), minibatch_size=10,
+                       max_epochs=2)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.add_plotters()
+    saved = root.common.disable.get("plotting", False)
+    root.common.disable.update({"plotting": False})
+    try:
+        wf.run()
+    finally:
+        root.common.disable.update({"plotting": saved})
+    return wf
+
+
+def test_registry_has_all_backends():
+    assert set(PublishingBackendRegistry.backends) >= {
+        "markdown", "jinja2", "pdf", "confluence"}
+
+
+def test_markdown_report(trained_workflow, tmp_path):
+    wf = trained_workflow
+    report = tmp_path / "report.md"
+    pub = Publisher(wf, backends={"markdown": {"file": str(report)}})
+    pub.initialize()
+    pub.run()
+    text = report.read_text()
+    assert wf.name in text
+    assert "## Results" in text
+    assert "## Unit run times" in text
+    assert "class lengths" in text
+    assert "digraph" in text          # the workflow graph is embedded
+    # plots were gathered and written next to the report
+    pngs = list(tmp_path.glob("*.png"))
+    assert pngs, "expected rendered plotter images"
+    assert "![" in text
+
+
+def test_pdf_report(trained_workflow, tmp_path):
+    wf = trained_workflow
+    report = tmp_path / "report.pdf"
+    pub = Publisher(wf, backends={"pdf": {"file": str(report)}})
+    pub.initialize()
+    pub.run()
+    blob = report.read_bytes()
+    assert blob.startswith(b"%PDF")
+    assert len(blob) > 1000
+
+
+def test_jinja2_custom_template(trained_workflow, tmp_path):
+    wf = trained_workflow
+    out = tmp_path / "custom.txt"
+    pub = Publisher(wf, backends={"jinja2": {
+        "file": str(out),
+        "template": "run {{ id }} of {{ name }}: "
+                    "{{ results | length }} metrics"}})
+    pub.initialize()
+    pub.run()
+    text = out.read_text()
+    assert wf.name in text and "metrics" in text
+
+
+def test_unknown_backend_rejected(trained_workflow):
+    pub = Publisher(trained_workflow, backends={"nope": {}})
+    with pytest.raises(ValueError, match="unknown publishing backend"):
+        pub.initialize()
+
+
+def test_disable_flag_skips_publishing(trained_workflow, tmp_path):
+    from veles_tpu.config import root
+    report = tmp_path / "skipped.md"
+    pub = Publisher(trained_workflow,
+                    backends={"markdown": {"file": str(report)}})
+    pub.initialize()
+    saved = root.common.disable.get("publishing", False)
+    root.common.disable.update({"publishing": True})
+    try:
+        pub.run()
+    finally:
+        root.common.disable.update({"publishing": saved})
+    assert not report.exists()
+
+
+def test_confluence_backend_posts_page(trained_workflow):
+    pages = []
+
+    class Stub(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            pages.append(json.loads(self.rfile.read(length)))
+            body = json.dumps({"id": "12345"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = HTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        pub = Publisher(trained_workflow, backends={"confluence": {
+            "server": "http://127.0.0.1:%d" % server.server_address[1],
+            "space": "ML", "username": "u", "password": "p"}})
+        pub.initialize()
+        pub.run()
+        assert len(pages) == 1
+        page = pages[0]
+        assert page["space"] == {"key": "ML"}
+        assert trained_workflow.name in page["title"]
+        assert "storage" in page["body"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_confluence_backend_gated_without_server(trained_workflow):
+    pub = Publisher(trained_workflow, backends={"confluence": {}})
+    with pytest.raises(ValueError, match="gated"):
+        pub.initialize()

@@ -1,0 +1,39 @@
+"""Directory-tree scanner shared by the file-based loaders.
+
+Collects ``(path, label_name)`` pairs where the label is the immediate
+parent directory name — the reference's path-derived labeling
+(``veles/loader/file_image.py``). Used by the image and sound loaders
+with different extension sets.
+"""
+
+import os
+import re
+
+
+class LabeledFileScanner(object):
+    """Deterministic recursive scan filtered by extension/regex."""
+
+    def __init__(self, extensions, ignored_dirs=(), filename_re=None):
+        self.extensions = tuple(ext.lower() for ext in extensions)
+        self.ignored_dirs = set(ignored_dirs)
+        self.filename_re = re.compile(filename_re) if filename_re else None
+
+    def scan(self, base):
+        if os.path.isfile(base):
+            return [(base, os.path.basename(
+                os.path.dirname(os.path.abspath(base))))]
+        found = []
+        # walk lazily: pruning via dirnames[:] only works on the live
+        # generator (a sorted(os.walk(...)) would visit ignored dirs)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in self.ignored_dirs)
+            for name in sorted(filenames):
+                if not name.lower().endswith(self.extensions):
+                    continue
+                if self.filename_re and not self.filename_re.search(name):
+                    continue
+                found.append((os.path.join(dirpath, name),
+                              os.path.basename(dirpath)))
+        found.sort()
+        return found

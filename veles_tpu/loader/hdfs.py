@@ -14,6 +14,7 @@ batch.
 import json
 import pickle
 import urllib.error
+import urllib.parse
 import urllib.request
 
 from veles_tpu.loader.fullbatch import FullBatchLoader
@@ -32,9 +33,10 @@ class WebHDFSClient(object):
     def _url(self, path, op):
         if not path.startswith("/"):
             path = "/" + path
-        url = "%s%s?op=%s" % (self.base, path, op)
+        url = "%s%s?op=%s" % (self.base,
+                              urllib.parse.quote(path), op)
         if self.user:
-            url += "&user.name=" + self.user
+            url += "&user.name=" + urllib.parse.quote(self.user, safe="")
         return url
 
     def status(self, path):

@@ -19,6 +19,7 @@ import re
 import numpy
 
 from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
+from veles_tpu.loader.file_scanner import LabeledFileScanner
 from veles_tpu.loader.fullbatch import FullBatchLoader, FullBatchLoaderMSE
 
 #: file extensions accepted by the directory scanners
@@ -48,31 +49,13 @@ def decode_image(path, size=None, color="RGB"):
     return arr
 
 
-class ImageScanner(object):
-    """Collects (path, label_name) pairs from a directory tree.
-
-    Labels come from the immediate parent directory name — the
-    reference's path-derived labeling (``loader/file_image.py``).
-    """
+class ImageScanner(LabeledFileScanner):
+    """Image-extension scan; labels from parent directory names."""
 
     def __init__(self, ignored_dirs=(), filename_re=None):
-        self.ignored_dirs = set(ignored_dirs)
-        self.filename_re = re.compile(filename_re) if filename_re else None
-
-    def scan(self, base):
-        found = []
-        for dirpath, dirnames, filenames in sorted(os.walk(base)):
-            dirnames[:] = sorted(d for d in dirnames
-                                 if d not in self.ignored_dirs)
-            for name in sorted(filenames):
-                if not name.lower().endswith(IMAGE_EXTENSIONS):
-                    continue
-                if self.filename_re and not self.filename_re.search(name):
-                    continue
-                label = os.path.basename(os.path.dirname(
-                    os.path.join(dirpath, name)))
-                found.append((os.path.join(dirpath, name), label))
-        return found
+        super(ImageScanner, self).__init__(
+            IMAGE_EXTENSIONS, ignored_dirs=ignored_dirs,
+            filename_re=filename_re)
 
 
 class FileImageLoader(FullBatchLoader):

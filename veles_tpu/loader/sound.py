@@ -18,6 +18,7 @@ import os
 import numpy
 
 from veles_tpu.loader.base import Loader  # noqa: F401 (registry import)
+from veles_tpu.loader.file_scanner import LabeledFileScanner
 from veles_tpu.loader.fullbatch import FullBatchLoader
 
 #: extensions decodable without optional deps
@@ -67,24 +68,19 @@ class SndFileLoader(FullBatchLoader):
         #: fixed number of frames per sample (pad/truncate target);
         #: None = infer from the first file
         self.samples = kwargs.pop("samples", None)
+        self.ignored_dirs = kwargs.pop("ignored_dirs", ())
+        self.filename_re = kwargs.pop("filename_re", None)
         super(SndFileLoader, self).__init__(workflow, **kwargs)
         self.labels_mapping = {}
         self.sample_rate = None
 
     def _scan_class(self, paths):
+        scanner = LabeledFileScanner(
+            WAV_EXTENSIONS + SOUNDFILE_EXTENSIONS,
+            ignored_dirs=self.ignored_dirs, filename_re=self.filename_re)
         found = []
-        exts = WAV_EXTENSIONS + SOUNDFILE_EXTENSIONS
         for base in paths:
-            if os.path.isfile(base):
-                found.append((base, os.path.basename(
-                    os.path.dirname(os.path.abspath(base)))))
-                continue
-            for dirpath, dirnames, filenames in sorted(os.walk(base)):
-                dirnames.sort()
-                for fn in sorted(filenames):
-                    if os.path.splitext(fn)[1].lower() in exts:
-                        found.append((os.path.join(dirpath, fn),
-                                      os.path.basename(dirpath)))
+            found.extend(scanner.scan(base))
         return found
 
     def _fit(self, data):

@@ -1,0 +1,20 @@
+"""Publishing: render a training-run report through pluggable backends.
+
+Re-designs ``veles/publishing/`` (Publisher at ``publisher.py:57-256``,
+backend registry at ``registry.py``, Markdown/Jinja2/PDF/Confluence
+backends). The :class:`Publisher` unit gathers everything knowable
+about the run — workflow identity, config text, loader statistics,
+per-unit run times, metric results, rendered plots, the DOT graph —
+into one ``info`` dict and hands it to each configured backend.
+"""
+
+from veles_tpu.publishing.backend import (Backend,  # noqa: F401
+                                          PublishingBackendRegistry)
+from veles_tpu.publishing.confluence_backend import \
+    ConfluenceBackend  # noqa: F401
+from veles_tpu.publishing.jinja2_template_backend import \
+    Jinja2TemplateBackend  # noqa: F401
+from veles_tpu.publishing.markdown_backend import \
+    MarkdownBackend  # noqa: F401
+from veles_tpu.publishing.pdf_backend import PdfBackend  # noqa: F401
+from veles_tpu.publishing.publisher import Publisher  # noqa: F401

@@ -218,12 +218,15 @@ class RESTfulAPI(Unit, TriviallyDistributable):
         # drain the body before ANY fail path: on a keep-alive
         # connection unread body bytes would be parsed as the next
         # request line, corrupting the client's following request
-        if handler.headers.get("Transfer-Encoding") and \
-                "Content-Length" not in handler.headers:
-            # chunked bodies can't be drained by length; close instead
-            # of letting the chunk bytes corrupt the next request
+        if handler.headers.get("Transfer-Encoding"):
+            # chunked bodies can't be drained by length — and a request
+            # carrying BOTH headers is the classic smuggling shape
+            # (RFC 7230: TE wins) — so reject either way and close
+            # before stray chunk bytes corrupt the next request
             handler.close_connection = True
-            self.fail(handler, "Content-Length required", code=411)
+            self.fail(handler, "Content-Length required "
+                               "(Transfer-Encoding is not supported)",
+                      code=411)
             return
         try:
             length = int(handler.headers.get("Content-Length", 0))

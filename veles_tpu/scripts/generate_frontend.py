@@ -22,6 +22,7 @@ _UNIT_MODULES = (
     "veles_tpu.downloader", "veles_tpu.avatar", "veles_tpu.input_joiner",
     "veles_tpu.mean_disp_normalizer", "veles_tpu.zmq_loader",
     "veles_tpu.genetics", "veles_tpu.ensemble", "veles_tpu.launcher",
+    "veles_tpu.publishing",
 )
 
 

@@ -215,13 +215,26 @@ class WebStatusServer(Logger):
             self.masters[mid] = dict(data, last_update=time.time())
         self.debug("master %s yielded an update", mid)
 
+    @staticmethod
+    def _validated(records):
+        # a single non-dict record would poison every later /service
+        # query (_match does record.get), so reject the batch up front
+        if isinstance(records, dict):
+            raise ValueError("records must be a list of objects")
+        records = list(records)
+        if not all(isinstance(rec, dict) for rec in records):
+            raise ValueError("every record must be a JSON object")
+        return records
+
     def receive_logs(self, data):
         records = data["logs"] if isinstance(data, dict) else data
+        records = self._validated(records)
         with self._lock:
             self.logs.extend(records)
 
     def receive_events(self, data):
         records = data["events"] if isinstance(data, dict) else data
+        records = self._validated(records)
         with self._lock:
             self.events.extend(records)
 
@@ -306,25 +319,31 @@ class WebStatusLogHandler(logging.Handler):
         with self._lock2:
             self._buffer.append(doc)
 
-    def _flush_loop(self, interval):
+    def _flush_once(self):
         import urllib.request
+        with self._lock2:
+            batch, self._buffer = self._buffer, []
+        if not batch:
+            return
+        try:
+            req = urllib.request.Request(
+                self.url, data=json.dumps({"logs": batch}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=2.0)
+        except Exception:
+            with self._lock2:  # keep for the next attempt, bounded
+                self._buffer = (batch + self._buffer)[-10000:]
+
+    def _flush_loop(self, interval):
         while not self._stop.wait(interval):
-            with self._lock2:
-                batch, self._buffer = self._buffer, []
-            if not batch:
-                continue
-            try:
-                req = urllib.request.Request(
-                    self.url, data=json.dumps({"logs": batch}).encode(),
-                    headers={"Content-Type": "application/json"})
-                urllib.request.urlopen(req, timeout=2.0)
-            except Exception:
-                with self._lock2:  # keep for the next attempt, bounded
-                    self._buffer = (batch + self._buffer)[-10000:]
+            self._flush_once()
 
     def close(self):
         self._stop.set()
         self._flusher.join(timeout=5)
+        # the last records before shutdown are usually the ones that
+        # explain it — flush them instead of dropping the buffer
+        self._flush_once()
         super(WebStatusLogHandler, self).close()
 
 
