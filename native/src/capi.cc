@@ -1,0 +1,67 @@
+// C API for ctypes bindings (veles_tpu/export/native.py). pybind11 is
+// not in this image, so the boundary is plain C: opaque handle, float
+// buffers, error strings copied into caller storage.
+
+#include <cstring>
+#include <string>
+
+#include "workflow_loader.h"
+
+namespace {
+
+struct Handle {
+  std::unique_ptr<veles_native::Workflow> workflow;
+};
+
+void CopyError(const std::string& message, char* err, int errlen) {
+  if (err != nullptr && errlen > 0) {
+    std::strncpy(err, message.c_str(), errlen - 1);
+    err[errlen - 1] = '\0';
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* vt_load(const char* path, char* err, int errlen) {
+  try {
+    auto handle = new Handle;
+    handle->workflow = veles_native::LoadWorkflow(path);
+    return handle;
+  } catch (const std::exception& e) {
+    CopyError(e.what(), err, errlen);
+    return nullptr;
+  }
+}
+
+void vt_free(void* handle) { delete static_cast<Handle*>(handle); }
+
+int64_t vt_input_size(void* handle) {
+  return static_cast<Handle*>(handle)->workflow->input_size();
+}
+
+int64_t vt_output_size(void* handle) {
+  return static_cast<Handle*>(handle)->workflow->output_size();
+}
+
+int vt_unit_count(void* handle) {
+  return static_cast<int>(
+      static_cast<Handle*>(handle)->workflow->unit_count());
+}
+
+// output must hold batch * vt_output_size floats; returns 0 on success
+int vt_run(void* handle, const float* input, int64_t batch, float* output,
+           char* err, int errlen) {
+  try {
+    auto* wf = static_cast<Handle*>(handle)->workflow.get();
+    std::vector<float> result = wf->Run(input, batch);
+    std::memcpy(output, result.data(), result.size() * sizeof(float));
+    return 0;
+  } catch (const std::exception& e) {
+    CopyError(e.what(), err, errlen);
+    return 1;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,71 @@
+#include "engine.h"
+
+#include <algorithm>
+
+namespace veles_native {
+
+ThreadPoolEngine::ThreadPoolEngine(int workers) {
+  if (workers <= 0) {
+    workers = std::max(1u, std::thread::hardware_concurrency());
+  }
+  for (int i = 0; i < workers; ++i) {
+    threads_.emplace_back([this] { WorkerLoop(); });
+  }
+}
+
+ThreadPoolEngine::~ThreadPoolEngine() {
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    shutdown_ = true;
+  }
+  work_cv_.notify_all();
+  for (std::thread& t : threads_) t.join();
+}
+
+void ThreadPoolEngine::WorkerLoop() {
+  while (true) {
+    std::function<void()> task;
+    {
+      std::unique_lock<std::mutex> lock(mutex_);
+      work_cv_.wait(lock, [this] { return shutdown_ || !queue_.empty(); });
+      if (queue_.empty()) {
+        if (shutdown_) return;
+        continue;
+      }
+      task = std::move(queue_.front());
+      queue_.pop();
+    }
+    task();
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      if (--outstanding_ == 0) done_cv_.notify_all();
+    }
+  }
+}
+
+void ThreadPoolEngine::ParallelFor(
+    int64_t count, const std::function<void(int64_t)>& fn) {
+  if (count <= 0) return;
+  int64_t shards =
+      std::min<int64_t>(count, static_cast<int64_t>(threads_.size()));
+  if (shards <= 1) {
+    for (int64_t i = 0; i < count; ++i) fn(i);
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> lock(mutex_);
+    outstanding_ += shards;
+    for (int64_t s = 0; s < shards; ++s) {
+      int64_t begin = count * s / shards;
+      int64_t end = count * (s + 1) / shards;
+      queue_.push([fn, begin, end] {
+        for (int64_t i = begin; i < end; ++i) fn(i);
+      });
+    }
+  }
+  work_cv_.notify_all();
+  std::unique_lock<std::mutex> lock(mutex_);
+  done_cv_.wait(lock, [this] { return outstanding_ == 0; });
+}
+
+}  // namespace veles_native

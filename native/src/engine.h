@@ -1,0 +1,37 @@
+// Execution engine (libVeles/src/engine.h ThreadPoolEngine): a fixed
+// thread pool draining a work queue. The inference chain is sequential
+// per sample, so the pool's job here is batch-parallelism: Execute
+// calls are sharded across workers when the batch is large enough.
+#pragma once
+
+#include <condition_variable>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace veles_native {
+
+class ThreadPoolEngine {
+ public:
+  explicit ThreadPoolEngine(int workers = 0);
+  ~ThreadPoolEngine();
+
+  // Runs fn(i) for i in [0, count) across the pool and waits.
+  void ParallelFor(int64_t count, const std::function<void(int64_t)>& fn);
+
+  int workers() const { return static_cast<int>(threads_.size()); }
+
+ private:
+  void WorkerLoop();
+
+  std::vector<std::thread> threads_;
+  std::mutex mutex_;
+  std::condition_variable work_cv_, done_cv_;
+  std::queue<std::function<void()>> queue_;
+  int64_t outstanding_ = 0;
+  bool shutdown_ = false;
+};
+
+}  // namespace veles_native

@@ -1,0 +1,163 @@
+#include "json.h"
+
+#include <cctype>
+#include <cstdlib>
+
+namespace veles_native {
+namespace {
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : text_(text) {}
+
+  JsonValue Parse() {
+    JsonValue v = ParseValue();
+    SkipWs();
+    if (pos_ != text_.size()) Fail("trailing characters");
+    return v;
+  }
+
+ private:
+  [[noreturn]] void Fail(const char* what) {
+    throw std::runtime_error(std::string("JSON parse error at ") +
+                             std::to_string(pos_) + ": " + what);
+  }
+
+  void SkipWs() {
+    while (pos_ < text_.size() &&
+           std::isspace(static_cast<unsigned char>(text_[pos_]))) {
+      ++pos_;
+    }
+  }
+
+  char Peek() {
+    if (pos_ >= text_.size()) Fail("unexpected end");
+    return text_[pos_];
+  }
+
+  char Next() {
+    char c = Peek();
+    ++pos_;
+    return c;
+  }
+
+  void Consume(const char* literal) {
+    for (const char* p = literal; *p; ++p) {
+      if (pos_ >= text_.size() || text_[pos_] != *p) Fail("bad literal");
+      ++pos_;
+    }
+  }
+
+  JsonValue ParseValue() {
+    SkipWs();
+    switch (Peek()) {
+      case '{': return ParseObject();
+      case '[': return ParseArray();
+      case '"': return JsonValue(ParseString());
+      case 't': Consume("true"); return JsonValue(true);
+      case 'f': Consume("false"); return JsonValue(false);
+      case 'n': Consume("null"); return JsonValue();
+      default: return ParseNumber();
+    }
+  }
+
+  JsonValue ParseObject() {
+    Consume("{");
+    JsonObject obj;
+    SkipWs();
+    if (Peek() == '}') { ++pos_; return JsonValue(std::move(obj)); }
+    while (true) {
+      SkipWs();
+      std::string key = ParseString();
+      SkipWs();
+      Consume(":");
+      obj.emplace(std::move(key), ParseValue());
+      SkipWs();
+      char c = Next();
+      if (c == '}') break;
+      if (c != ',') Fail("expected , or }");
+    }
+    return JsonValue(std::move(obj));
+  }
+
+  JsonValue ParseArray() {
+    Consume("[");
+    JsonArray arr;
+    SkipWs();
+    if (Peek() == ']') { ++pos_; return JsonValue(std::move(arr)); }
+    while (true) {
+      arr.push_back(ParseValue());
+      SkipWs();
+      char c = Next();
+      if (c == ']') break;
+      if (c != ',') Fail("expected , or ]");
+    }
+    return JsonValue(std::move(arr));
+  }
+
+  std::string ParseString() {
+    if (Next() != '"') Fail("expected string");
+    std::string out;
+    while (true) {
+      char c = Next();
+      if (c == '"') break;
+      if (c == '\\') {
+        char esc = Next();
+        switch (esc) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos_ + 4 > text_.size()) Fail("bad \\u escape");
+            unsigned code = std::stoul(text_.substr(pos_, 4), nullptr, 16);
+            pos_ += 4;
+            // UTF-8 encode (surrogate pairs folded to U+FFFD is fine
+            // for this runtime's ASCII-dominated metadata)
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: Fail("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+
+  JsonValue ParseNumber() {
+    size_t start = pos_;
+    if (Peek() == '-') ++pos_;
+    while (pos_ < text_.size() &&
+           (std::isdigit(static_cast<unsigned char>(text_[pos_])) ||
+            text_[pos_] == '.' || text_[pos_] == 'e' || text_[pos_] == 'E' ||
+            text_[pos_] == '+' || text_[pos_] == '-')) {
+      ++pos_;
+    }
+    if (pos_ == start) Fail("expected number");
+    return JsonValue(std::strtod(text_.c_str() + start, nullptr));
+  }
+
+  const std::string& text_;
+  size_t pos_ = 0;
+};
+
+}  // namespace
+
+JsonValue ParseJson(const std::string& text) { return Parser(text).Parse(); }
+
+}  // namespace veles_native

@@ -1,0 +1,78 @@
+// Minimal JSON value + recursive-descent parser.
+//
+// The reference runtime used vendored rapidjson
+// (libVeles/src/workflow_loader.cc); this image ships no JSON library,
+// so the runtime carries its own ~250-line parser. Full JSON: objects,
+// arrays, strings (with \uXXXX), numbers, true/false/null.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+class JsonValue;
+using JsonObject = std::map<std::string, JsonValue>;
+using JsonArray = std::vector<JsonValue>;
+
+class JsonValue {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  JsonValue() : type_(Type::Null) {}
+  explicit JsonValue(bool b) : type_(Type::Bool), bool_(b) {}
+  explicit JsonValue(double d) : type_(Type::Number), num_(d) {}
+  explicit JsonValue(std::string s)
+      : type_(Type::String), str_(std::move(s)) {}
+  explicit JsonValue(JsonArray a)
+      : type_(Type::Array), arr_(std::make_shared<JsonArray>(std::move(a))) {}
+  explicit JsonValue(JsonObject o)
+      : type_(Type::Object),
+        obj_(std::make_shared<JsonObject>(std::move(o))) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_object() const { return type_ == Type::Object; }
+
+  bool as_bool() const { Expect(Type::Bool); return bool_; }
+  double as_double() const { Expect(Type::Number); return num_; }
+  int64_t as_int() const {
+    Expect(Type::Number);
+    return static_cast<int64_t>(num_);
+  }
+  const std::string& as_string() const { Expect(Type::String); return str_; }
+  const JsonArray& as_array() const { Expect(Type::Array); return *arr_; }
+  const JsonObject& as_object() const { Expect(Type::Object); return *obj_; }
+
+  // object lookup; throws std::out_of_range when missing
+  const JsonValue& at(const std::string& key) const {
+    return as_object().at(key);
+  }
+  bool contains(const std::string& key) const {
+    return is_object() && obj_->count(key) > 0;
+  }
+
+ private:
+  void Expect(Type t) const {
+    if (type_ != t) throw std::runtime_error("JSON type mismatch");
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0.0;
+  std::string str_;
+  std::shared_ptr<JsonArray> arr_;
+  std::shared_ptr<JsonObject> obj_;
+};
+
+// Parses a complete JSON document; throws std::runtime_error on error.
+JsonValue ParseJson(const std::string& text);
+
+}  // namespace veles_native

@@ -1,0 +1,55 @@
+// CLI runner: veles_native_run <package.tar|dir> <input.npy> <output.npy>
+//
+// The standalone-inference entry the reference's libVeles offered to
+// embedded apps: load an exported package, run the forward pass on a
+// batch from a .npy file, write the result as .npy.
+
+#include <cstdio>
+#include <fstream>
+#include <iterator>
+
+#include "npy.h"
+#include "workflow_loader.h"
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr,
+                 "usage: %s <package.tar|package-dir> <input.npy> "
+                 "<output.npy>\n",
+                 argv[0]);
+    return 2;
+  }
+  try {
+    auto workflow = veles_native::LoadWorkflow(argv[1]);
+
+    std::ifstream in(argv[2], std::ios::binary);
+    if (!in) throw std::runtime_error(std::string("cannot open ") + argv[2]);
+    std::vector<char> bytes((std::istreambuf_iterator<char>(in)),
+                            std::istreambuf_iterator<char>());
+    veles_native::NpyArray input = veles_native::ParseNpy(bytes);
+    if (input.shape.empty()) throw std::runtime_error("scalar input");
+    int64_t batch = input.shape[0];
+    int64_t sample = input.size() / batch;
+    if (sample != workflow->input_size()) {
+      throw std::runtime_error(
+          "input sample size " + std::to_string(sample) +
+          " != workflow input " + std::to_string(workflow->input_size()));
+    }
+
+    std::vector<float> output = workflow->Run(input.data.data(), batch);
+
+    std::vector<int64_t> out_shape = {batch};
+    for (int64_t d : workflow->output_shape()) out_shape.push_back(d);
+    std::vector<char> blob = veles_native::WriteNpy(out_shape,
+                                                    output.data());
+    std::ofstream out(argv[3], std::ios::binary);
+    out.write(blob.data(), blob.size());
+    std::fprintf(stderr, "%s: %lld samples -> %s\n",
+                 workflow->name.c_str(), static_cast<long long>(batch),
+                 argv[3]);
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

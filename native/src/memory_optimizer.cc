@@ -1,0 +1,47 @@
+#include "memory_optimizer.h"
+
+#include <algorithm>
+
+namespace veles_native {
+
+namespace {
+constexpr int64_t kAlign = 16;  // floats; keeps SIMD-friendly rows
+
+int64_t AlignUp(int64_t v) { return (v + kAlign - 1) / kAlign * kAlign; }
+}  // namespace
+
+int64_t MemoryOptimizer::Optimize(std::vector<MemoryNode>* nodes) const {
+  // big-first first-fit: classic interval-graph offset assignment,
+  // same strategy family as the reference's optimizer
+  std::vector<MemoryNode*> order;
+  for (MemoryNode& node : *nodes) order.push_back(&node);
+  std::sort(order.begin(), order.end(),
+            [](const MemoryNode* a, const MemoryNode* b) {
+              return a->value > b->value;
+            });
+  int64_t arena = 0;
+  for (MemoryNode* node : order) {
+    // collect [offset, end) spans of already-placed conflicting nodes
+    std::vector<std::pair<int64_t, int64_t>> taken;
+    for (const MemoryNode* other : order) {
+      if (other == node || other->position < 0) continue;
+      bool overlap = node->time_start < other->time_finish &&
+                     other->time_start < node->time_finish;
+      if (overlap) {
+        taken.emplace_back(other->position,
+                           other->position + AlignUp(other->value));
+      }
+    }
+    std::sort(taken.begin(), taken.end());
+    int64_t at = 0;
+    for (const auto& span : taken) {
+      if (at + AlignUp(node->value) <= span.first) break;  // fits in gap
+      at = std::max(at, span.second);
+    }
+    node->position = at;
+    arena = std::max(arena, at + AlignUp(node->value));
+  }
+  return arena;
+}
+
+}  // namespace veles_native

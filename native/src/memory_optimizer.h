@@ -1,0 +1,25 @@
+// Static memory planner (libVeles/src/memory_optimizer.cc): unit
+// output buffers are live intervals [time_start, time_finish) with a
+// size; the planner packs them into one arena by first-fit offset
+// assignment over conflicting intervals and returns the arena size.
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+namespace veles_native {
+
+struct MemoryNode {
+  int64_t time_start = 0;   // first step writing the buffer
+  int64_t time_finish = 0;  // last step reading it (exclusive end)
+  int64_t value = 0;        // floats needed
+  int64_t position = -1;    // assigned arena offset (output)
+};
+
+class MemoryOptimizer {
+ public:
+  // Assigns node positions; returns the total arena size (floats).
+  int64_t Optimize(std::vector<MemoryNode>* nodes) const;
+};
+
+}  // namespace veles_native

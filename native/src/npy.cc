@@ -1,0 +1,161 @@
+#include "npy.h"
+
+#include <cstring>
+#include <sstream>
+#include <stdexcept>
+
+namespace veles_native {
+namespace {
+
+const char kMagic[] = "\x93NUMPY";
+
+// pulls 'key': value out of the python-dict-literal header
+std::string HeaderField(const std::string& header, const std::string& key) {
+  size_t at = header.find("'" + key + "'");
+  if (at == std::string::npos) {
+    throw std::runtime_error("npy header missing " + key);
+  }
+  at = header.find(':', at);
+  size_t end = at + 1;
+  int depth = 0;
+  while (end < header.size()) {
+    char c = header[end];
+    if (c == '(' || c == '[') ++depth;
+    if (c == ')' || c == ']') --depth;
+    if ((c == ',' || c == '}') && depth <= 0) break;
+    ++end;
+  }
+  std::string value = header.substr(at + 1, end - at - 1);
+  // trim
+  size_t a = value.find_first_not_of(" \t");
+  size_t b = value.find_last_not_of(" \t");
+  return a == std::string::npos ? "" : value.substr(a, b - a + 1);
+}
+
+template <typename T>
+void Convert(const char* payload, int64_t count, std::vector<float>* out) {
+  out->resize(count);
+  const T* typed = reinterpret_cast<const T*>(payload);
+  for (int64_t i = 0; i < count; ++i) {
+    (*out)[i] = static_cast<float>(typed[i]);
+  }
+}
+
+}  // namespace
+
+NpyArray ParseNpy(const std::vector<char>& bytes) {
+  if (bytes.size() < 10 || std::memcmp(bytes.data(), kMagic, 6) != 0) {
+    throw std::runtime_error("not a .npy file");
+  }
+  uint8_t major = bytes[6];
+  size_t header_len, header_at;
+  if (major == 1) {
+    header_len = static_cast<uint8_t>(bytes[8]) |
+                 (static_cast<uint8_t>(bytes[9]) << 8);
+    header_at = 10;
+  } else {
+    uint32_t len;
+    std::memcpy(&len, bytes.data() + 8, 4);
+    header_len = len;
+    header_at = 12;
+  }
+  if (header_at + header_len > bytes.size()) {
+    throw std::runtime_error("truncated .npy header");
+  }
+  std::string header(bytes.data() + header_at, header_len);
+
+  if (HeaderField(header, "fortran_order").find("True") !=
+      std::string::npos) {
+    throw std::runtime_error("fortran-order .npy not supported");
+  }
+
+  NpyArray result;
+  std::string shape = HeaderField(header, "shape");
+  std::stringstream ss(shape);
+  char c;
+  int64_t dim;
+  while (ss >> c) {
+    if (c == '(' || c == ',' || c == ')') continue;
+    ss.putback(c);
+    if (ss >> dim) result.shape.push_back(dim);
+  }
+
+  std::string descr = HeaderField(header, "descr");
+  // strip quotes
+  size_t q1 = descr.find('\'');
+  size_t q2 = descr.rfind('\'');
+  if (q1 != std::string::npos && q2 > q1) {
+    descr = descr.substr(q1 + 1, q2 - q1 - 1);
+  }
+  if (!descr.empty() && descr[0] == '>') {
+    throw std::runtime_error("big-endian .npy not supported");
+  }
+  std::string kind = descr.substr(descr.find_first_not_of("<=|"));
+
+  const char* payload = bytes.data() + header_at + header_len;
+  int64_t count = result.size();
+  int64_t avail = static_cast<int64_t>(bytes.size()) -
+                  static_cast<int64_t>(header_at + header_len);
+  auto need = [&](int64_t bytes_per) {
+    if (count * bytes_per > avail) {
+      throw std::runtime_error("truncated .npy payload");
+    }
+  };
+  if (kind == "f4") {
+    need(4);
+    Convert<float>(payload, count, &result.data);
+  } else if (kind == "f8") {
+    need(8);
+    Convert<double>(payload, count, &result.data);
+  } else if (kind == "i8") {
+    need(8);
+    Convert<int64_t>(payload, count, &result.data);
+  } else if (kind == "i4") {
+    need(4);
+    Convert<int32_t>(payload, count, &result.data);
+  } else if (kind == "i2") {
+    need(2);
+    Convert<int16_t>(payload, count, &result.data);
+  } else if (kind == "i1") {
+    need(1);
+    Convert<int8_t>(payload, count, &result.data);
+  } else if (kind == "u1") {
+    need(1);
+    Convert<uint8_t>(payload, count, &result.data);
+  } else {
+    throw std::runtime_error("unsupported .npy dtype: " + descr);
+  }
+  return result;
+}
+
+std::vector<char> WriteNpy(const std::vector<int64_t>& shape,
+                           const float* data) {
+  std::string shape_str = "(";
+  for (size_t i = 0; i < shape.size(); ++i) {
+    shape_str += std::to_string(shape[i]);
+    shape_str += ", ";
+  }
+  if (shape.size() > 1) shape_str.resize(shape_str.size() - 1);  // keep ','
+  shape_str += ")";
+  std::string header = "{'descr': '<f4', 'fortran_order': False, 'shape': " +
+                       shape_str + ", }";
+  size_t total = 10 + header.size() + 1;
+  size_t pad = (64 - total % 64) % 64;
+  header += std::string(pad, ' ');
+  header += '\n';
+
+  int64_t count = 1;
+  for (int64_t d : shape) count *= d;
+  std::vector<char> out(10 + header.size() + count * sizeof(float));
+  std::memcpy(out.data(), kMagic, 6);
+  out[6] = 1;
+  out[7] = 0;
+  out[8] = static_cast<char>(header.size() & 0xFF);
+  out[9] = static_cast<char>((header.size() >> 8) & 0xFF);
+  std::memcpy(out.data() + 10, header.data(), header.size());
+  std::memcpy(out.data() + 10 + header.size(), data,
+              count * sizeof(float));
+  return out;
+}
+
+}  // namespace veles_native

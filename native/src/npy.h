@@ -1,0 +1,30 @@
+// .npy reader/writer (the reference's numpy_array_loader,
+// libVeles/src/numpy_array_loader.cc): header parse, little-endian
+// f4/f8/i1/i2/i4/i8/u1 payloads converted to float32, C order only.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+struct NpyArray {
+  std::vector<int64_t> shape;
+  std::vector<float> data;  // converted to float32
+
+  int64_t size() const {
+    int64_t n = 1;
+    for (int64_t d : shape) n *= d;
+    return n;
+  }
+};
+
+// Parses a complete .npy byte buffer; throws std::runtime_error.
+NpyArray ParseNpy(const std::vector<char>& bytes);
+
+// Serializes float32 data as .npy (v1.0 header).
+std::vector<char> WriteNpy(const std::vector<int64_t>& shape,
+                           const float* data);
+
+}  // namespace veles_native

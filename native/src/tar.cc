@@ -1,0 +1,88 @@
+#include "tar.h"
+
+#include <sys/stat.h>
+
+#include <cstring>
+#include <dirent.h>
+#include <fstream>
+#include <stdexcept>
+
+namespace veles_native {
+namespace {
+
+std::vector<char> ReadFile(const std::string& path) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) throw std::runtime_error("cannot open " + path);
+  return std::vector<char>(std::istreambuf_iterator<char>(in),
+                           std::istreambuf_iterator<char>());
+}
+
+int64_t ParseOctal(const char* field, size_t len) {
+  int64_t value = 0;
+  for (size_t i = 0; i < len && field[i]; ++i) {
+    char c = field[i];
+    if (c == ' ') continue;
+    if (c < '0' || c > '7') break;
+    value = value * 8 + (c - '0');
+  }
+  return value;
+}
+
+Archive ReadTar(const std::string& path) {
+  std::vector<char> bytes = ReadFile(path);
+  Archive archive;
+  size_t at = 0;
+  while (at + 512 <= bytes.size()) {
+    const char* header = bytes.data() + at;
+    if (header[0] == '\0') break;  // end-of-archive zero block
+    std::string name(header, strnlen(header, 100));
+    int64_t size = ParseOctal(header + 124, 12);
+    char type = header[156];
+    at += 512;
+    if (at + size > bytes.size()) {
+      throw std::runtime_error("truncated tar member " + name);
+    }
+    if (type == '0' || type == '\0') {  // regular file
+      archive[name] = std::vector<char>(bytes.begin() + at,
+                                        bytes.begin() + at + size);
+    }
+    at += (size + 511) / 512 * 512;  // payload is 512-padded
+  }
+  if (archive.empty()) {
+    throw std::runtime_error("empty or invalid tar: " + path);
+  }
+  return archive;
+}
+
+Archive ReadDirectory(const std::string& path) {
+  Archive archive;
+  DIR* dir = opendir(path.c_str());
+  if (dir == nullptr) throw std::runtime_error("cannot open " + path);
+  struct dirent* entry;
+  while ((entry = readdir(dir)) != nullptr) {
+    std::string name = entry->d_name;
+    if (name == "." || name == "..") continue;
+    std::string full = path + "/" + name;
+    struct stat st;
+    if (stat(full.c_str(), &st) == 0 && S_ISREG(st.st_mode)) {
+      archive[name] = ReadFile(full);
+    }
+  }
+  closedir(dir);
+  if (archive.empty()) {
+    throw std::runtime_error("empty package directory: " + path);
+  }
+  return archive;
+}
+
+}  // namespace
+
+Archive ReadPackage(const std::string& path) {
+  struct stat st;
+  if (stat(path.c_str(), &st) != 0) {
+    throw std::runtime_error("no such package: " + path);
+  }
+  return S_ISDIR(st.st_mode) ? ReadDirectory(path) : ReadTar(path);
+}
+
+}  // namespace veles_native

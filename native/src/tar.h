@@ -1,0 +1,21 @@
+// Minimal POSIX-tar (ustar) reader — the package container.
+//
+// The reference linked libarchive (libVeles/src/workflow_archive.cc);
+// packages here are written by Python's tarfile with no compression,
+// so 100 lines of ustar parsing replace the dependency. Also supports
+// plain directories (a package can be an unpacked folder).
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+namespace veles_native {
+
+// member name -> raw bytes
+using Archive = std::map<std::string, std::vector<char>>;
+
+// Reads a .tar file or a directory into memory; throws on error.
+Archive ReadPackage(const std::string& path);
+
+}  // namespace veles_native

@@ -1,0 +1,110 @@
+// Inference unit interface + factory registry.
+//
+// Mirrors libVeles's Unit/UnitFactory (libVeles/inc/veles/unit.h,
+// src/unit_factory.cc:40-65): units are constructed by UUID or class
+// name, receive properties (scalars, lists, arrays) from the package
+// loader, compute their output shape from the input shape, and execute
+// batch-at-a-time on float32 buffers.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "json.h"
+#include "npy.h"
+
+namespace veles_native {
+
+// sample shape, excluding the batch dimension
+using Shape = std::vector<int64_t>;
+
+inline int64_t ShapeSize(const Shape& shape) {
+  int64_t n = 1;
+  for (int64_t d : shape) n *= d;
+  return n;
+}
+
+class Unit {
+ public:
+  virtual ~Unit() = default;
+
+  virtual const char* Name() const = 0;
+
+  // Scalar/array property assignment (the libVeles SetParameter
+  // contract). Arrays arrive resolved from @NNNN members.
+  virtual void SetParameter(const std::string& name, const JsonValue& value) {
+    params_[name] = value;
+  }
+  virtual void SetArray(const std::string& name, NpyArray array) {
+    arrays_[name] = std::move(array);
+  }
+
+  // Shape propagation; called once before execution.
+  virtual Shape Initialize(const Shape& input_shape) = 0;
+
+  // input: batch x ShapeSize(input_shape), output: batch x output size.
+  virtual void Execute(const float* input, float* output,
+                       int64_t batch) const = 0;
+
+  const Shape& output_shape() const { return output_shape_; }
+  const Shape& input_shape() const { return input_shape_; }
+
+ protected:
+  double Param(const std::string& name, double fallback) const {
+    auto it = params_.find(name);
+    return it == params_.end() ? fallback : it->second.as_double();
+  }
+  std::string StrParam(const std::string& name,
+                       const std::string& fallback) const {
+    auto it = params_.find(name);
+    return it == params_.end() || !it->second.is_string()
+               ? fallback
+               : it->second.as_string();
+  }
+  std::vector<int64_t> IntListParam(const std::string& name) const {
+    std::vector<int64_t> out;
+    auto it = params_.find(name);
+    if (it != params_.end() && it->second.is_array()) {
+      for (const auto& v : it->second.as_array()) {
+        out.push_back(v.as_int());
+      }
+    }
+    return out;
+  }
+  const NpyArray* Array(const std::string& name) const {
+    auto it = arrays_.find(name);
+    return it == arrays_.end() ? nullptr : &it->second;
+  }
+
+  std::map<std::string, JsonValue> params_;
+  std::map<std::string, NpyArray> arrays_;
+  Shape input_shape_, output_shape_;
+};
+
+class UnitFactory {
+ public:
+  using Constructor = std::function<std::unique_ptr<Unit>()>;
+
+  static UnitFactory& Instance();
+
+  void Register(const std::string& class_name, Constructor ctor);
+  // also register the stable UUID exported by the Python side
+  void RegisterUuid(const std::string& uuid, const std::string& class_name);
+
+  // by class name or UUID; throws std::runtime_error when unknown
+  std::unique_ptr<Unit> Create(const std::string& key) const;
+  std::vector<std::string> Known() const;
+
+ private:
+  std::map<std::string, Constructor> ctors_;
+  std::map<std::string, std::string> uuid_to_name_;
+};
+
+// defined in units.cc: registers every built-in unit type
+void RegisterBuiltinUnits();
+
+}  // namespace veles_native

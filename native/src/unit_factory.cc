@@ -1,0 +1,46 @@
+#include <stdexcept>
+
+#include "unit.h"
+
+namespace veles_native {
+
+UnitFactory& UnitFactory::Instance() {
+  static UnitFactory factory;
+  static bool initialized = false;
+  if (!initialized) {
+    initialized = true;  // set first: RegisterBuiltinUnits re-enters
+    RegisterBuiltinUnits();
+  }
+  return factory;
+}
+
+void UnitFactory::Register(const std::string& class_name, Constructor ctor) {
+  ctors_[class_name] = std::move(ctor);
+}
+
+void UnitFactory::RegisterUuid(const std::string& uuid,
+                               const std::string& class_name) {
+  uuid_to_name_[uuid] = class_name;
+}
+
+std::unique_ptr<Unit> UnitFactory::Create(const std::string& key) const {
+  auto it = ctors_.find(key);
+  if (it == ctors_.end()) {
+    auto uuid_it = uuid_to_name_.find(key);
+    if (uuid_it != uuid_to_name_.end()) {
+      it = ctors_.find(uuid_it->second);
+    }
+  }
+  if (it == ctors_.end()) {
+    throw std::runtime_error("unknown unit type: " + key);
+  }
+  return it->second();
+}
+
+std::vector<std::string> UnitFactory::Known() const {
+  std::vector<std::string> names;
+  for (const auto& kv : ctors_) names.push_back(kv.first);
+  return names;
+}
+
+}  // namespace veles_native

@@ -1,0 +1,426 @@
+// Built-in inference units (the libZnicz role: All2All/Conv/Pooling/
+// activations — cf. docs/source/manualrst_veles_algorithms.rst).
+//
+// Numerics deliberately mirror veles_tpu/nn/*.py so the native runtime
+// reproduces the JAX forward pass: LeCun-scaled tanh, softplus "relu"
+// with the 15.0 clamp, max-subtracted softmax, NHWC/HWIO convolution,
+// full-window average pooling, AlexNet cross-channel LRN.
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <stdexcept>
+
+#include "unit.h"
+
+namespace veles_native {
+namespace {
+
+// ---------------------------------------------------------------- activations
+
+using ActFn = float (*)(float);
+
+float ActLinear(float x) { return x; }
+float ActTanh(float x) { return 1.7159f * std::tanh(0.6666f * x); }
+float ActSigmoid(float x) { return 1.0f / (1.0f + std::exp(-x)); }
+float ActReluSoft(float x) {
+  // Znicz "RELU": log(1+exp(x)), clamped like the Python side
+  return x > 15.0f ? x : std::log1p(std::exp(std::min(x, 15.0f)));
+}
+float ActReluStrict(float x) { return std::max(x, 0.0f); }
+float ActLeakyRelu(float x) { return x >= 0.0f ? x : 0.01f * x; }
+float ActLog(float x) { return std::log(x + std::sqrt(x * x + 1.0f)); }
+
+ActFn ActivationByName(const std::string& name) {
+  if (name == "linear" || name.empty()) return ActLinear;
+  if (name == "tanh") return ActTanh;
+  if (name == "sigmoid") return ActSigmoid;
+  if (name == "relu") return ActReluSoft;
+  if (name == "strict_relu") return ActReluStrict;
+  if (name == "leaky_relu") return ActLeakyRelu;
+  if (name == "log") return ActLog;
+  throw std::runtime_error("unknown activation: " + name);
+}
+
+void Softmax(float* row, int64_t n) {
+  float mx = row[0];
+  for (int64_t i = 1; i < n; ++i) mx = std::max(mx, row[i]);
+  float sum = 0.0f;
+  for (int64_t i = 0; i < n; ++i) {
+    row[i] = std::exp(row[i] - mx);
+    sum += row[i];
+  }
+  for (int64_t i = 0; i < n; ++i) row[i] /= sum;
+}
+
+// ------------------------------------------------------------------- All2All
+
+class All2AllUnit : public Unit {
+ public:
+  const char* Name() const override { return "All2All"; }
+
+  Shape Initialize(const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    const NpyArray* w = Array("weights");
+    if (w == nullptr || w->shape.size() != 2) {
+      throw std::runtime_error("All2All needs 2-D weights");
+    }
+    in_features_ = w->shape[0];
+    out_features_ = w->shape[1];
+    if (ShapeSize(input_shape) != in_features_) {
+      throw std::runtime_error("All2All input/weights shape mismatch");
+    }
+    activation_name_ = StrParam("activation", "linear");
+    if (activation_name_ != "softmax") {
+      act_ = ActivationByName(activation_name_);
+    }
+    output_shape_ = IntListParam("output_sample_shape");
+    if (output_shape_.empty()) output_shape_ = {out_features_};
+    if (ShapeSize(output_shape_) != out_features_) {
+      throw std::runtime_error("output_sample_shape/weights mismatch");
+    }
+    return output_shape_;
+  }
+
+  void Execute(const float* input, float* output,
+               int64_t batch) const override {
+    const float* w = Array("weights")->data.data();
+    const NpyArray* bias = Array("bias");
+    for (int64_t b = 0; b < batch; ++b) {
+      float* out_row = output + b * out_features_;
+      const float* in_row = input + b * in_features_;
+      if (bias != nullptr) {
+        std::memcpy(out_row, bias->data.data(),
+                    out_features_ * sizeof(float));
+      } else {
+        std::fill(out_row, out_row + out_features_, 0.0f);
+      }
+      // i-k-j: streams the weight rows, accumulates into out_row
+      for (int64_t k = 0; k < in_features_; ++k) {
+        float x = in_row[k];
+        if (x == 0.0f) continue;
+        const float* w_row = w + k * out_features_;
+        for (int64_t j = 0; j < out_features_; ++j) {
+          out_row[j] += x * w_row[j];
+        }
+      }
+      if (activation_name_ == "softmax") {
+        Softmax(out_row, out_features_);
+      } else {
+        for (int64_t j = 0; j < out_features_; ++j) {
+          out_row[j] = act_(out_row[j]);
+        }
+      }
+    }
+  }
+
+ private:
+  int64_t in_features_ = 0, out_features_ = 0;
+  std::string activation_name_;
+  ActFn act_ = ActLinear;
+};
+
+// ---------------------------------------------------------------------- Conv
+
+class ConvUnit : public Unit {
+ public:
+  const char* Name() const override { return "Conv"; }
+
+  Shape Initialize(const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    // grayscale HW -> HWC with one channel (matches the Python x[..., None])
+    h_ = input_shape[0];
+    w_ = input_shape[1];
+    c_ = input_shape.size() >= 3 ? input_shape[2] : 1;
+    const NpyArray* w = Array("weights");
+    if (w == nullptr || w->shape.size() != 4) {
+      throw std::runtime_error("Conv needs HWIO weights");
+    }
+    ky_ = w->shape[0];
+    kx_ = w->shape[1];
+    if (w->shape[2] != c_) {
+      throw std::runtime_error("Conv channels mismatch");
+    }
+    n_kernels_ = w->shape[3];
+    auto sliding = IntListParam("sliding");
+    sx_ = sliding.size() > 0 ? sliding[0] : 1;
+    sy_ = sliding.size() > 1 ? sliding[1] : 1;
+    ResolvePadding();
+    out_h_ = (h_ + pad_top_ + pad_bottom_ - ky_) / sy_ + 1;
+    out_w_ = (w_ + pad_left_ + pad_right_ - kx_) / sx_ + 1;
+    if (out_h_ <= 0 || out_w_ <= 0) {
+      throw std::runtime_error("Conv output would be empty");
+    }
+    activation_ = ActivationByName(StrParam("activation", "linear"));
+    output_shape_ = {out_h_, out_w_, n_kernels_};
+    return output_shape_;
+  }
+
+  void Execute(const float* input, float* output,
+               int64_t batch) const override {
+    const float* weights = Array("weights")->data.data();
+    const NpyArray* bias = Array("bias");
+    int64_t in_size = h_ * w_ * c_;
+    int64_t out_size = out_h_ * out_w_ * n_kernels_;
+    for (int64_t b = 0; b < batch; ++b) {
+      const float* x = input + b * in_size;
+      float* y = output + b * out_size;
+      for (int64_t oy = 0; oy < out_h_; ++oy) {
+        for (int64_t ox = 0; ox < out_w_; ++ox) {
+          float* cell = y + (oy * out_w_ + ox) * n_kernels_;
+          if (bias != nullptr) {
+            std::memcpy(cell, bias->data.data(),
+                        n_kernels_ * sizeof(float));
+          } else {
+            std::fill(cell, cell + n_kernels_, 0.0f);
+          }
+          for (int64_t fy = 0; fy < ky_; ++fy) {
+            int64_t iy = oy * sy_ + fy - pad_top_;
+            if (iy < 0 || iy >= h_) continue;
+            for (int64_t fx = 0; fx < kx_; ++fx) {
+              int64_t ix = ox * sx_ + fx - pad_left_;
+              if (ix < 0 || ix >= w_) continue;
+              const float* px = x + (iy * w_ + ix) * c_;
+              const float* wk = weights + ((fy * kx_ + fx) * c_) *
+                                              n_kernels_;
+              for (int64_t ci = 0; ci < c_; ++ci) {
+                float v = px[ci];
+                if (v == 0.0f) continue;
+                const float* w_row = wk + ci * n_kernels_;
+                for (int64_t k = 0; k < n_kernels_; ++k) {
+                  cell[k] += v * w_row[k];
+                }
+              }
+            }
+          }
+          for (int64_t k = 0; k < n_kernels_; ++k) {
+            cell[k] = activation_(cell[k]);
+          }
+        }
+      }
+    }
+  }
+
+ private:
+  void ResolvePadding() {
+    pad_left_ = pad_top_ = pad_right_ = pad_bottom_ = 0;
+    auto it = params_.find("padding");
+    if (it == params_.end()) return;
+    if (it->second.is_string()) {
+      const std::string& mode = it->second.as_string();
+      if (mode == "VALID") return;
+      if (mode == "SAME") {
+        // XLA SAME: out = ceil(in / stride), pad split low-first
+        int64_t out_h = (h_ + sy_ - 1) / sy_;
+        int64_t out_w = (w_ + sx_ - 1) / sx_;
+        int64_t total_h =
+            std::max<int64_t>((out_h - 1) * sy_ + ky_ - h_, 0);
+        int64_t total_w =
+            std::max<int64_t>((out_w - 1) * sx_ + kx_ - w_, 0);
+        pad_top_ = total_h / 2;
+        pad_bottom_ = total_h - pad_top_;
+        pad_left_ = total_w / 2;
+        pad_right_ = total_w - pad_left_;
+        return;
+      }
+      throw std::runtime_error("unknown padding mode: " + mode);
+    }
+    auto pads = IntListParam("padding");  // [left, top, right, bottom]
+    if (pads.size() == 4) {
+      pad_left_ = pads[0];
+      pad_top_ = pads[1];
+      pad_right_ = pads[2];
+      pad_bottom_ = pads[3];
+    }
+  }
+
+  int64_t h_ = 0, w_ = 0, c_ = 0;
+  int64_t ky_ = 0, kx_ = 0, n_kernels_ = 0;
+  int64_t sx_ = 1, sy_ = 1;
+  int64_t pad_left_ = 0, pad_top_ = 0, pad_right_ = 0, pad_bottom_ = 0;
+  int64_t out_h_ = 0, out_w_ = 0;
+  ActFn activation_ = ActLinear;
+};
+
+// ------------------------------------------------------------------- pooling
+
+enum class PoolKind { Max, MaxAbs, Avg };
+
+template <PoolKind kKind>
+class PoolingUnit : public Unit {
+ public:
+  const char* Name() const override { return "Pooling"; }
+
+  Shape Initialize(const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    h_ = input_shape[0];
+    w_ = input_shape[1];
+    c_ = input_shape.size() >= 3 ? input_shape[2] : 1;
+    kx_ = static_cast<int64_t>(Param("kx", 2));
+    ky_ = static_cast<int64_t>(Param("ky", 2));
+    auto sliding = IntListParam("sliding");
+    sx_ = sliding.size() > 0 ? sliding[0] : kx_;
+    sy_ = sliding.size() > 1 ? sliding[1] : ky_;
+    out_h_ = (h_ - ky_) / sy_ + 1;
+    out_w_ = (w_ - kx_) / sx_ + 1;
+    if (out_h_ <= 0 || out_w_ <= 0) {
+      throw std::runtime_error("pooling output would be empty");
+    }
+    output_shape_ = {out_h_, out_w_, c_};
+    return output_shape_;
+  }
+
+  void Execute(const float* input, float* output,
+               int64_t batch) const override {
+    int64_t in_size = h_ * w_ * c_;
+    int64_t out_size = out_h_ * out_w_ * c_;
+    for (int64_t b = 0; b < batch; ++b) {
+      const float* x = input + b * in_size;
+      float* y = output + b * out_size;
+      for (int64_t oy = 0; oy < out_h_; ++oy) {
+        for (int64_t ox = 0; ox < out_w_; ++ox) {
+          for (int64_t ci = 0; ci < c_; ++ci) {
+            float mx = -INFINITY, mn = INFINITY, sum = 0.0f;
+            for (int64_t fy = 0; fy < ky_; ++fy) {
+              for (int64_t fx = 0; fx < kx_; ++fx) {
+                float v = x[((oy * sy_ + fy) * w_ + ox * sx_ + fx) * c_ +
+                            ci];
+                mx = std::max(mx, v);
+                mn = std::min(mn, v);
+                sum += v;
+              }
+            }
+            float result;
+            if constexpr (kKind == PoolKind::Max) {
+              result = mx;
+            } else if constexpr (kKind == PoolKind::MaxAbs) {
+              result = mx >= -mn ? mx : mn;
+            } else {
+              result = sum / static_cast<float>(kx_ * ky_);
+            }
+            y[(oy * out_w_ + ox) * c_ + ci] = result;
+          }
+        }
+      }
+    }
+  }
+
+ private:
+  int64_t h_ = 0, w_ = 0, c_ = 0;
+  int64_t kx_ = 2, ky_ = 2, sx_ = 2, sy_ = 2;
+  int64_t out_h_ = 0, out_w_ = 0;
+};
+
+// ----------------------------------------------------------------------- LRN
+
+class LrnUnit : public Unit {
+ public:
+  const char* Name() const override { return "LRNormalizerForward"; }
+
+  Shape Initialize(const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    output_shape_ = input_shape;
+    k_ = static_cast<float>(Param("k", 2.0));
+    alpha_ = static_cast<float>(Param("alpha", 1e-4));
+    beta_ = static_cast<float>(Param("beta", 0.75));
+    n_ = static_cast<int64_t>(Param("n", 5));
+    channels_ = input_shape.back();
+    pixels_ = ShapeSize(input_shape) / channels_;
+    return output_shape_;
+  }
+
+  void Execute(const float* input, float* output,
+               int64_t batch) const override {
+    int64_t half = n_ / 2;
+    int64_t size = pixels_ * channels_;
+    for (int64_t b = 0; b < batch; ++b) {
+      const float* x = input + b * size;
+      float* y = output + b * size;
+      for (int64_t p = 0; p < pixels_; ++p) {
+        const float* px = x + p * channels_;
+        float* py = y + p * channels_;
+        for (int64_t ci = 0; ci < channels_; ++ci) {
+          float window = 0.0f;
+          int64_t lo = std::max<int64_t>(0, ci - half);
+          int64_t hi = std::min(channels_ - 1, ci + half);
+          for (int64_t j = lo; j <= hi; ++j) {
+            window += px[j] * px[j];
+          }
+          py[ci] = px[ci] / std::pow(k_ + alpha_ * window, beta_);
+        }
+      }
+    }
+  }
+
+ private:
+  float k_ = 2.0f, alpha_ = 1e-4f, beta_ = 0.75f;
+  int64_t n_ = 5, channels_ = 0, pixels_ = 0;
+};
+
+// ------------------------------------------------------ activation / identity
+
+class ActivationUnitImpl : public Unit {
+ public:
+  const char* Name() const override { return "ActivationUnit"; }
+
+  Shape Initialize(const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    output_shape_ = input_shape;
+    act_ = ActivationByName(StrParam("activation", "linear"));
+    return output_shape_;
+  }
+
+  void Execute(const float* input, float* output,
+               int64_t batch) const override {
+    int64_t count = batch * ShapeSize(input_shape_);
+    for (int64_t i = 0; i < count; ++i) output[i] = act_(input[i]);
+  }
+
+ private:
+  ActFn act_ = ActLinear;
+};
+
+class IdentityUnit : public Unit {
+ public:
+  const char* Name() const override { return "Identity"; }
+
+  Shape Initialize(const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    output_shape_ = input_shape;
+    return output_shape_;
+  }
+
+  void Execute(const float* input, float* output,
+               int64_t batch) const override {
+    std::memcpy(output, input,
+                batch * ShapeSize(input_shape_) * sizeof(float));
+  }
+};
+
+template <typename T>
+std::unique_ptr<Unit> Make() {
+  return std::make_unique<T>();
+}
+
+}  // namespace
+
+void RegisterBuiltinUnits() {
+  UnitFactory& f = UnitFactory::Instance();
+  for (const char* name :
+       {"All2All", "All2AllTanh", "All2AllRELU", "All2AllStrictRELU",
+        "All2AllSigmoid", "All2AllSoftmax"}) {
+    f.Register(name, Make<All2AllUnit>);
+  }
+  for (const char* name :
+       {"Conv", "ConvTanh", "ConvRELU", "ConvStrictRELU", "ConvSigmoid"}) {
+    f.Register(name, Make<ConvUnit>);
+  }
+  f.Register("MaxPooling", Make<PoolingUnit<PoolKind::Max>>);
+  f.Register("MaxAbsPooling", Make<PoolingUnit<PoolKind::MaxAbs>>);
+  f.Register("AvgPooling", Make<PoolingUnit<PoolKind::Avg>>);
+  f.Register("LRNormalizerForward", Make<LrnUnit>);
+  f.Register("ActivationUnit", Make<ActivationUnitImpl>);
+  f.Register("DropoutForward", Make<IdentityUnit>);
+}
+
+}  // namespace veles_native

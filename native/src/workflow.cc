@@ -1,0 +1,62 @@
+#include "workflow.h"
+
+#include <stdexcept>
+
+#include "memory_optimizer.h"
+
+namespace veles_native {
+
+Workflow::Workflow(std::shared_ptr<ThreadPoolEngine> engine)
+    : engine_(engine ? std::move(engine)
+                     : std::make_shared<ThreadPoolEngine>()) {}
+
+void Workflow::AddUnit(std::unique_ptr<Unit> unit) {
+  units_.push_back(std::move(unit));
+  initialized_ = false;
+}
+
+void Workflow::Initialize(const Shape& input_shape) {
+  if (units_.empty()) throw std::runtime_error("workflow has no units");
+  input_shape_ = input_shape;
+  Shape shape = input_shape;
+  std::vector<MemoryNode> nodes(units_.size());
+  for (size_t i = 0; i < units_.size(); ++i) {
+    shape = units_[i]->Initialize(shape);
+    // unit i's output lives from step i until step i+1 consumed it;
+    // the final output lives to the end (it is returned)
+    nodes[i].time_start = static_cast<int64_t>(i);
+    nodes[i].time_finish = static_cast<int64_t>(
+        i + 1 == units_.size() ? units_.size() + 1 : i + 2);
+    nodes[i].value = ShapeSize(shape);  // per-sample floats
+  }
+  arena_size_ = MemoryOptimizer().Optimize(&nodes);
+  offsets_.clear();
+  for (const MemoryNode& node : nodes) offsets_.push_back(node.position);
+  initialized_ = true;
+}
+
+const Shape& Workflow::output_shape() const {
+  if (units_.empty()) throw std::runtime_error("workflow has no units");
+  return units_.back()->output_shape();
+}
+
+std::vector<float> Workflow::Run(const float* input, int64_t batch) const {
+  if (!initialized_) throw std::runtime_error("Initialize() first");
+  std::vector<float> result(batch * output_size());
+  // per-sample arena keeps every worker's scratch independent, so the
+  // batch shards freely across the pool
+  engine_->ParallelFor(batch, [&](int64_t b) {
+    std::vector<float> arena(arena_size_);
+    const float* current = input + b * input_size();
+    for (size_t i = 0; i < units_.size(); ++i) {
+      float* out = i + 1 == units_.size()
+                       ? result.data() + b * output_size()
+                       : arena.data() + offsets_[i];
+      units_[i]->Execute(current, out, 1);
+      current = out;
+    }
+  });
+  return result;
+}
+
+}  // namespace veles_native

@@ -1,0 +1,48 @@
+// Inference workflow: the loaded unit chain + arena-planned buffers.
+// Mirrors libVeles Workflow::Initialize/Run (libVeles/src/workflow.cc:
+// 73-123): Initialize packs unit output buffers into one arena via the
+// MemoryOptimizer, Run executes the chain (batch-sharded on the
+// ThreadPoolEngine).
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "engine.h"
+#include "unit.h"
+
+namespace veles_native {
+
+class Workflow {
+ public:
+  explicit Workflow(std::shared_ptr<ThreadPoolEngine> engine = nullptr);
+
+  void AddUnit(std::unique_ptr<Unit> unit);
+
+  // Propagates shapes through the chain and plans the arena.
+  void Initialize(const Shape& input_shape);
+
+  // input: batch x input_size floats; returns batch x output_size.
+  std::vector<float> Run(const float* input, int64_t batch) const;
+
+  const Shape& input_shape() const { return input_shape_; }
+  const Shape& output_shape() const;
+  int64_t input_size() const { return ShapeSize(input_shape_); }
+  int64_t output_size() const { return ShapeSize(output_shape()); }
+  size_t unit_count() const { return units_.size(); }
+  int64_t arena_size() const { return arena_size_; }
+
+  std::string name;
+  std::string checksum;
+
+ private:
+  std::shared_ptr<ThreadPoolEngine> engine_;
+  std::vector<std::unique_ptr<Unit>> units_;
+  std::vector<int64_t> offsets_;  // per-unit output offset in the arena
+  Shape input_shape_;
+  int64_t arena_size_ = 0;
+  bool initialized_ = false;
+};
+
+}  // namespace veles_native

@@ -1,0 +1,81 @@
+#include "workflow_loader.h"
+
+#include <stdexcept>
+
+#include "json.h"
+#include "npy.h"
+#include "tar.h"
+
+namespace veles_native {
+namespace {
+
+// "@0000_64x10" -> member "@0000_64x10.npy"
+bool IsArrayRef(const JsonValue& value) {
+  return value.is_string() && !value.as_string().empty() &&
+         value.as_string()[0] == '@';
+}
+
+}  // namespace
+
+std::unique_ptr<Workflow> LoadWorkflow(
+    const std::string& package_path,
+    std::shared_ptr<ThreadPoolEngine> engine) {
+  Archive archive = ReadPackage(package_path);
+  auto contents_it = archive.find("contents.json");
+  if (contents_it == archive.end()) {
+    throw std::runtime_error("package has no contents.json");
+  }
+  JsonValue contents = ParseJson(std::string(
+      contents_it->second.begin(), contents_it->second.end()));
+
+  const JsonValue& wf_json = contents.at("workflow");
+  auto workflow = std::make_unique<Workflow>(std::move(engine));
+  workflow->name =
+      wf_json.contains("name") ? wf_json.at("name").as_string() : "";
+  workflow->checksum = wf_json.contains("checksum")
+                           ? wf_json.at("checksum").as_string()
+                           : "";
+
+  for (const JsonValue& unit_json : wf_json.at("units").as_array()) {
+    const JsonValue& cls = unit_json.at("class");
+    std::unique_ptr<Unit> unit;
+    // class name first, exported UUID as the fallback key — both are
+    // registered (libVeles keyed on UUID only)
+    try {
+      unit = UnitFactory::Instance().Create(cls.at("name").as_string());
+    } catch (const std::runtime_error&) {
+      if (cls.contains("uuid") && cls.at("uuid").is_string()) {
+        unit = UnitFactory::Instance().Create(cls.at("uuid").as_string());
+      } else {
+        throw;
+      }
+    }
+    for (const auto& kv : unit_json.at("data").as_object()) {
+      if (IsArrayRef(kv.second)) {
+        std::string member = kv.second.as_string() + ".npy";
+        auto it = archive.find(member);
+        if (it == archive.end()) {
+          throw std::runtime_error("missing package member " + member);
+        }
+        unit->SetArray(kv.first, ParseNpy(it->second));
+      } else {
+        unit->SetParameter(kv.first, kv.second);
+      }
+    }
+    workflow->AddUnit(std::move(unit));
+  }
+
+  if (contents.contains("input_shape") &&
+      contents.at("input_shape").is_array()) {
+    const JsonArray& dims = contents.at("input_shape").as_array();
+    Shape shape;
+    // first dim of the recorded minibatch shape is the batch — skip it
+    for (size_t i = 1; i < dims.size(); ++i) {
+      shape.push_back(dims[i].as_int());
+    }
+    if (!shape.empty()) workflow->Initialize(shape);
+  }
+  return workflow;
+}
+
+}  // namespace veles_native

@@ -1,0 +1,16 @@
+// Package loader: contents.json + @NNNN .npy members -> Workflow.
+// Mirrors libVeles WorkflowLoader::Load (src/workflow_loader.cc:42-133).
+#pragma once
+
+#include <memory>
+#include <string>
+
+#include "workflow.h"
+
+namespace veles_native {
+
+std::unique_ptr<Workflow> LoadWorkflow(
+    const std::string& package_path,
+    std::shared_ptr<ThreadPoolEngine> engine = nullptr);
+
+}  // namespace veles_native

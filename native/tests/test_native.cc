@@ -1,0 +1,152 @@
+// Native runtime self-tests (the libVeles/tests/ role, without gtest:
+// plain asserts, exit code = failure count).
+
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <vector>
+
+#include "json.h"
+#include "memory_optimizer.h"
+#include "npy.h"
+#include "unit.h"
+#include "workflow.h"
+
+using namespace veles_native;
+
+static int failures = 0;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,    \
+                   #cond);                                            \
+      ++failures;                                                     \
+    }                                                                 \
+  } while (0)
+
+static void TestJson() {
+  JsonValue v = ParseJson(
+      "{\"a\": [1, 2.5, -3], \"s\": \"x\\ny\", \"t\": true, "
+      "\"n\": null, \"nested\": {\"k\": \"@0001_2x3\"}}");
+  CHECK(v.at("a").as_array().size() == 3);
+  CHECK(v.at("a").as_array()[1].as_double() == 2.5);
+  CHECK(v.at("a").as_array()[2].as_int() == -3);
+  CHECK(v.at("s").as_string() == "x\ny");
+  CHECK(v.at("t").as_bool());
+  CHECK(v.at("n").is_null());
+  CHECK(v.at("nested").at("k").as_string() == "@0001_2x3");
+  bool threw = false;
+  try {
+    ParseJson("{broken");
+  } catch (const std::runtime_error&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+static void TestNpyRoundtrip() {
+  std::vector<float> data = {1.5f, -2.0f, 3.25f, 0.0f, 5.0f, -6.5f};
+  std::vector<char> blob = WriteNpy({2, 3}, data.data());
+  NpyArray back = ParseNpy(blob);
+  CHECK(back.shape == std::vector<int64_t>({2, 3}));
+  for (int i = 0; i < 6; ++i) CHECK(back.data[i] == data[i]);
+}
+
+static void TestMemoryOptimizer() {
+  // three sequential buffers: 0 and 2 don't overlap -> may share
+  std::vector<MemoryNode> nodes = {
+      {0, 2, 100, -1}, {1, 3, 50, -1}, {2, 4, 100, -1}};
+  int64_t arena = MemoryOptimizer().Optimize(&nodes);
+  for (const MemoryNode& n : nodes) CHECK(n.position >= 0);
+  // conflicting pairs must not overlap in the arena
+  auto end = [](const MemoryNode& n) { return n.position + n.value; };
+  CHECK(nodes[0].position >= end(nodes[1]) ||
+        nodes[1].position >= end(nodes[0]));
+  CHECK(nodes[1].position >= end(nodes[2]) ||
+        nodes[2].position >= end(nodes[1]));
+  // arena smaller than the no-sharing total (0 and 2 alias)
+  CHECK(arena < 100 + 50 + 100);
+}
+
+static void TestAll2AllSoftmax() {
+  auto unit = UnitFactory::Instance().Create("All2AllSoftmax");
+  NpyArray weights;
+  weights.shape = {2, 3};
+  weights.data = {1, 0, 0, 0, 1, 0};  // maps (x0,x1) -> (x0,x1,0) logits
+  unit->SetArray("weights", std::move(weights));
+  unit->SetParameter("activation", JsonValue(std::string("softmax")));
+  Shape out = unit->Initialize({2});
+  CHECK(out == Shape({3}));
+  float input[2] = {1.0f, 2.0f};
+  float output[3];
+  unit->Execute(input, output, 1);
+  float sum = output[0] + output[1] + output[2];
+  CHECK(std::fabs(sum - 1.0f) < 1e-5f);
+  CHECK(output[1] > output[0] && output[0] > output[2]);
+}
+
+static void TestConvIdentityKernel() {
+  auto unit = UnitFactory::Instance().Create("Conv");
+  NpyArray weights;  // 1x1 conv, identity over channels=1
+  weights.shape = {1, 1, 1, 1};
+  weights.data = {2.0f};
+  unit->SetArray("weights", std::move(weights));
+  Shape out = unit->Initialize({3, 3, 1});
+  CHECK(out == Shape({3, 3, 1}));
+  std::vector<float> x = {1, 2, 3, 4, 5, 6, 7, 8, 9};
+  std::vector<float> y(9);
+  unit->Execute(x.data(), y.data(), 1);
+  for (int i = 0; i < 9; ++i) CHECK(y[i] == 2.0f * x[i]);
+}
+
+static void TestPoolingAndChain() {
+  Workflow wf;
+  {
+    auto pool = UnitFactory::Instance().Create("MaxPooling");
+    pool->SetParameter("kx", JsonValue(2.0));
+    pool->SetParameter("ky", JsonValue(2.0));
+    wf.AddUnit(std::move(pool));
+  }
+  {
+    auto act = UnitFactory::Instance().Create("ActivationUnit");
+    act->SetParameter("activation",
+                      JsonValue(std::string("strict_relu")));
+    wf.AddUnit(std::move(act));
+  }
+  wf.Initialize({4, 4, 1});
+  CHECK(wf.output_shape() == Shape({2, 2, 1}));
+  std::vector<float> x(16);
+  for (int i = 0; i < 16; ++i) x[i] = static_cast<float>(i - 8);
+  std::vector<float> y = wf.Run(x.data(), 1);
+  // max pool of [-8..7] 4x4 -> {-3, -1, 5, 7}, relu -> {0, 0, 5, 7}
+  CHECK(y.size() == 4);
+  CHECK(y[0] == 0.0f && y[1] == 0.0f && y[2] == 5.0f && y[3] == 7.0f);
+}
+
+static void TestBatchSharding() {
+  Workflow wf;
+  auto act = UnitFactory::Instance().Create("ActivationUnit");
+  act->SetParameter("activation", JsonValue(std::string("tanh")));
+  wf.AddUnit(std::move(act));
+  wf.Initialize({8});
+  std::vector<float> x(64 * 8);
+  for (size_t i = 0; i < x.size(); ++i) x[i] = 0.001f * i;
+  std::vector<float> y = wf.Run(x.data(), 64);
+  for (size_t i = 0; i < x.size(); ++i) {
+    float expect = 1.7159f * std::tanh(0.6666f * x[i]);
+    CHECK(std::fabs(y[i] - expect) < 1e-6f);
+  }
+}
+
+int main() {
+  TestJson();
+  TestNpyRoundtrip();
+  TestMemoryOptimizer();
+  TestAll2AllSoftmax();
+  TestConvIdentityKernel();
+  TestPoolingAndChain();
+  TestBatchSharding();
+  if (failures == 0) std::printf("all native tests passed\n");
+  return failures;
+}

@@ -1,0 +1,195 @@
+"""Export package + native runtime parity
+(reference: libVeles/tests/ + the package_export contract)."""
+
+import json
+import subprocess
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.export.package import load_package_info
+
+
+def _mnist_workflow():
+    from veles_tpu.models.mnist import MnistWorkflow
+
+    def provider():
+        rng = numpy.random.RandomState(0)
+        return (rng.rand(40, 8, 8).astype(numpy.float32),
+                rng.randint(0, 10, 40).astype(numpy.int32),
+                rng.rand(10, 8, 8).astype(numpy.float32),
+                rng.randint(0, 10, 10).astype(numpy.int32))
+
+    prng.get().seed(21)
+    prng.get("loader").seed(22)
+    wf = MnistWorkflow(provider=provider, layers=(16,), minibatch_size=10,
+                       max_epochs=1)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    return wf
+
+
+def _conv_workflow():
+    from veles_tpu.loader.base import Loader
+    from veles_tpu.standard_workflow import StandardWorkflow
+
+    class TinyImages(Loader):
+        hide_from_registry = True
+
+        def load_data(self):
+            self.class_lengths = [0, 8, 24]
+            rng = numpy.random.RandomState(1)
+            self._data = rng.rand(32, 8, 8, 3).astype(numpy.float32)
+            self._labels = rng.randint(0, 4, 32).astype(numpy.int32)
+
+        def create_minibatch_data(self):
+            self.minibatch_data.reset(numpy.zeros(
+                (self.max_minibatch_size, 8, 8, 3), numpy.float32))
+
+        def fill_minibatch(self):
+            idx = self.minibatch_indices.mem[:self.minibatch_size]
+            self.minibatch_data.map_invalidate()[:self.minibatch_size] = \
+                self._data[idx]
+            self.minibatch_labels.map_invalidate()[:self.minibatch_size] = \
+                self._labels[idx]
+
+    prng.get().seed(31)
+    prng.get("loader").seed(32)
+    wf = StandardWorkflow(
+        loader=lambda w: TinyImages(w, minibatch_size=8),
+        layers=[
+            {"type": "conv_relu", "n_kernels": 4, "kx": 3, "ky": 3},
+            {"type": "norm"},
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            {"type": "all2all_tanh", "output_sample_shape": 12},
+            {"type": "dropout", "dropout_ratio": 0.3},
+            {"type": "softmax", "output_sample_shape": 4},
+        ],
+        loss="softmax", max_epochs=1)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    return wf
+
+
+def _jax_forward(wf, batch):
+    """The Python-side reference forward in testing mode."""
+    wf.set_testing(True)
+    import jax.numpy as jnp
+    x = jnp.asarray(batch)
+    for fwd in wf.forwards:
+        params = {k: jnp.asarray(numpy.asarray(v))
+                  for k, v in fwd.param_values().items()}
+        x = fwd.apply(params, x)
+    return numpy.asarray(x)
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    from veles_tpu.export.native import build_native
+    try:
+        build_native()
+    except Exception as e:
+        pytest.skip("native toolchain unavailable: %s" % e)
+    return True
+
+
+def test_package_contents_schema(tmp_path):
+    wf = _mnist_workflow()
+    path = wf.package_export(str(tmp_path / "model.tar"))
+    contents, members = load_package_info(path)
+    assert contents["format_version"] == 1
+    assert contents["workflow"]["name"] == wf.name
+    assert contents["workflow"]["checksum"] == wf.checksum
+    units = contents["workflow"]["units"]
+    assert [u["class"]["name"] for u in units] == \
+        ["All2AllTanh", "All2AllSoftmax"]
+    for unit in units:
+        assert unit["class"]["uuid"]
+        ref = unit["data"]["weights"]
+        assert ref.startswith("@")
+        assert (ref + ".npy") in members
+    assert "contents.json" in members
+
+
+def test_native_matches_jax_mnist(native_lib, tmp_path):
+    from veles_tpu.export.native import NativeWorkflow
+    wf = _mnist_workflow()
+    path = wf.package_export(str(tmp_path / "model.tar"))
+    rng = numpy.random.RandomState(7)
+    batch = rng.rand(12, 8, 8).astype(numpy.float32)
+    expect = _jax_forward(wf, batch).reshape(12, -1)
+    with NativeWorkflow(path) as native:
+        assert native.unit_count == 2
+        got = native.run(batch)
+    numpy.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-6)
+
+
+def test_native_matches_jax_conv_stack(native_lib, tmp_path):
+    from veles_tpu.export.native import NativeWorkflow
+    wf = _conv_workflow()
+    path = wf.package_export(str(tmp_path / "conv"))  # directory package
+    rng = numpy.random.RandomState(8)
+    batch = rng.rand(6, 8, 8, 3).astype(numpy.float32)
+    expect = _jax_forward(wf, batch).reshape(6, -1)
+    with NativeWorkflow(path) as native:
+        assert native.unit_count == 6
+        got = native.run(batch)
+    numpy.testing.assert_allclose(got, expect, rtol=5e-5, atol=5e-6)
+
+
+def test_cli_runner_end_to_end(native_lib, tmp_path):
+    from veles_tpu.export.native import runner_path
+    wf = _mnist_workflow()
+    package = wf.package_export(str(tmp_path / "model.tar"))
+    rng = numpy.random.RandomState(9)
+    batch = rng.rand(5, 8, 8).astype(numpy.float32)
+    numpy.save(tmp_path / "input.npy", batch)
+    out_path = tmp_path / "output.npy"
+    proc = subprocess.run(
+        [runner_path(), package, str(tmp_path / "input.npy"),
+         str(out_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    got = numpy.load(out_path)
+    expect = _jax_forward(wf, batch).reshape(5, -1)
+    numpy.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-6)
+
+
+def test_cpp_unit_tests(native_lib):
+    from veles_tpu.export.native import test_binary_path
+    proc = subprocess.run([test_binary_path()], capture_output=True,
+                          text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_unsupported_unit_rejected(tmp_path):
+    from veles_tpu.export.package import export_workflow
+
+    class Odd(object):
+        pass
+
+    class FakeWf(object):
+        name = "fake"
+        checksum = "x"
+        forwards = [Odd()]
+        loader = None
+
+    with pytest.raises(NotImplementedError, match="not exportable"):
+        export_workflow(FakeWf(), str(tmp_path / "x.tar"))
+
+
+def test_stablehlo_member_present(tmp_path):
+    wf = _mnist_workflow()
+    path = wf.package_export(str(tmp_path / "model.tar"))
+    _, members = load_package_info(path)
+    if "model.stablehlo" not in members:
+        pytest.skip("jax.export unavailable in this jax build")
+    # sanity: the artifact deserializes and matches shapes
+    from jax import export as jax_export
+    import tarfile
+    with tarfile.open(path) as tar:
+        blob = tar.extractfile("model.stablehlo").read()
+    exported = jax_export.deserialize(bytearray(blob))
+    assert exported is not None

@@ -141,6 +141,44 @@ def test_confluence_backend_posts_page(trained_workflow):
         server.server_close()
 
 
+def test_backend_failure_does_not_abort_others(trained_workflow, tmp_path):
+    ok = tmp_path / "ok.md"
+    pub = Publisher(trained_workflow, backends={
+        "jinja2": {"file": str(tmp_path / "broken.txt"),
+                   "template": "{{ results | bogus_filter }}"},
+        "markdown": {"file": str(ok)},
+    })
+    pub.initialize()
+    pub.run()  # must not raise
+    assert ok.exists()
+
+
+def test_missing_file_kwarg_rejected(trained_workflow):
+    pub = Publisher(trained_workflow, backends={"markdown": {}})
+    with pytest.raises(ValueError, match="file"):
+        pub.initialize()
+
+
+def test_refill_does_not_duplicate_accumulated_points(trained_workflow,
+                                                      tmp_path):
+    wf = trained_workflow
+    plotter = next(p for p in wf.plotters if hasattr(p, "values"))
+    before = list(plotter.values)
+    assert before, "fixture plotter accumulated during training"
+    pub = Publisher(wf, backends={
+        "markdown": {"file": str(tmp_path / "r.md")}})
+    pub.initialize()
+    pub.run()
+    assert plotter.values == before  # no duplicate/erased points
+
+
+def test_duplicate_unit_names_keep_all_rows(trained_workflow):
+    pub = Publisher(trained_workflow, backends={})
+    pub.initialize()
+    stats = pub._run_times_by_unit()
+    assert len(stats) == len(trained_workflow.units)
+
+
 def test_confluence_backend_gated_without_server(trained_workflow):
     pub = Publisher(trained_workflow, backends={"confluence": {}})
     with pytest.raises(ValueError, match="gated"):

@@ -1,0 +1,15 @@
+"""Inference package export + native runtime bindings.
+
+Re-designs the reference's ``Workflow.package_export``
+(``veles/workflow.py:868-975``) and the libVeles consumption side
+(``libVeles/src/workflow_loader.cc``): a trained workflow's forward
+chain is serialized to a self-contained package — ``contents.json``
+describing the unit chain (class names + stable UUIDs + properties,
+array properties as ``@NNNN_shape`` references) next to ``.npy``
+members — which the C++ runtime under ``native/`` loads and executes
+without any Python. A serialized StableHLO artifact (``jax.export``)
+rides along for PJRT-based deployments.
+"""
+
+from veles_tpu.export.package import (export_workflow,  # noqa: F401
+                                      load_package_info)

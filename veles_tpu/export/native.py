@@ -1,0 +1,133 @@
+"""ctypes bindings + build driver for the native runtime (``native/``).
+
+pybind11 is not in this image, so the Python↔C++ boundary is the plain
+C API in ``native/src/capi.cc`` loaded through :mod:`ctypes`. The
+shared library is built on demand with CMake+ninja/make into
+``native/build`` and cached there (the XLA-compile-cache idea applied
+to the runtime itself).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+def build_native(force=False):
+    """Build (or reuse) the native runtime; returns the .so path."""
+    lib_path = os.path.join(BUILD_DIR, "libveles_native.so")
+    with _build_lock:
+        if os.path.exists(lib_path) and not force:
+            return lib_path
+        os.makedirs(BUILD_DIR, exist_ok=True)
+        subprocess.run(
+            ["cmake", "-S", NATIVE_DIR, "-B", BUILD_DIR,
+             "-DCMAKE_BUILD_TYPE=Release"],
+            check=True, capture_output=True)
+        subprocess.run(
+            ["cmake", "--build", BUILD_DIR, "--parallel"],
+            check=True, capture_output=True)
+    return lib_path
+
+
+def runner_path():
+    """Path of the CLI runner binary (builds if needed)."""
+    build_native()
+    return os.path.join(BUILD_DIR, "veles_native_run")
+
+
+def test_binary_path():
+    build_native()
+    return os.path.join(BUILD_DIR, "veles_native_test")
+
+
+def _load_library():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(build_native())
+    lib.vt_load.restype = ctypes.c_void_p
+    lib.vt_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.vt_free.argtypes = [ctypes.c_void_p]
+    lib.vt_input_size.restype = ctypes.c_int64
+    lib.vt_input_size.argtypes = [ctypes.c_void_p]
+    lib.vt_output_size.restype = ctypes.c_int64
+    lib.vt_output_size.argtypes = [ctypes.c_void_p]
+    lib.vt_unit_count.restype = ctypes.c_int
+    lib.vt_unit_count.argtypes = [ctypes.c_void_p]
+    lib.vt_run.restype = ctypes.c_int
+    lib.vt_run.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_char_p, ctypes.c_int]
+    _lib = lib
+    return lib
+
+
+class NativeWorkflow(object):
+    """A loaded inference package, executed by the C++ runtime."""
+
+    def __init__(self, package_path):
+        self._lib = _load_library()
+        err = ctypes.create_string_buffer(1024)
+        self._handle = self._lib.vt_load(
+            str(package_path).encode(), err, len(err))
+        if not self._handle:
+            raise RuntimeError("native load failed: %s" %
+                               err.value.decode(errors="replace"))
+
+    @property
+    def input_size(self):
+        return self._lib.vt_input_size(self._handle)
+
+    @property
+    def output_size(self):
+        return self._lib.vt_output_size(self._handle)
+
+    @property
+    def unit_count(self):
+        return self._lib.vt_unit_count(self._handle)
+
+    def run(self, batch):
+        """batch: (n, *sample_shape) float array → (n, output_size)."""
+        batch = numpy.ascontiguousarray(batch, numpy.float32)
+        n = batch.shape[0]
+        if batch.size != n * self.input_size:
+            raise ValueError("sample size %d != workflow input %d" %
+                             (batch.size // max(n, 1), self.input_size))
+        out = numpy.empty((n, self.output_size), numpy.float32)
+        err = ctypes.create_string_buffer(1024)
+        rc = self._lib.vt_run(
+            self._handle,
+            batch.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            err, len(err))
+        if rc != 0:
+            raise RuntimeError("native run failed: %s" %
+                               err.value.decode(errors="replace"))
+        return out
+
+    def close(self):
+        if self._handle:
+            self._lib.vt_free(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -29,6 +29,9 @@ class Plotter(Unit):
         super(Plotter, self).__init__(workflow, **kwargs)
         self.clear_plot = kwargs.get("clear_plot", False)
         self.redraw_plot = kwargs.get("redraw_plot", True)
+        #: True once fill() captured live data (the publisher must not
+        #: re-fill a plotter that accumulated state during the run)
+        self.has_filled = False
         self.last_figure_ = None
 
     @property
@@ -49,6 +52,7 @@ class Plotter(Unit):
         if not self.enabled:
             return
         self.fill()
+        self.has_filled = True
         server = self._find_server()
         if server is not None:
             server.enqueue(self)

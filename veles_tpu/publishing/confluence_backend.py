@@ -20,6 +20,8 @@ from veles_tpu.publishing.markdown_backend import MarkdownBackend
 
 class ConfluenceBackend(MarkdownBackend):
     MAPPING = "confluence"
+    requires_file = False    # publishes to the server, not a path
+    image_formats = ()       # report text only
 
     def __init__(self, **kwargs):
         kwargs.setdefault("file", None)

@@ -68,6 +68,8 @@ class Jinja2TemplateBackend(Backend):
     """Renders ``info`` through a jinja2 template."""
 
     MAPPING = "jinja2"
+    #: subclasses that publish elsewhere (Confluence) may opt out
+    requires_file = True
 
     def __init__(self, **kwargs):
         super(Jinja2TemplateBackend, self).__init__(**kwargs)
@@ -77,8 +79,16 @@ class Jinja2TemplateBackend(Backend):
             with open(template_file) as fin:
                 self.template_text = fin.read()
         self.file = kwargs.get("file")
+        if self.file is None and self.requires_file:
+            # a misspelled kwarg must not silently render to nowhere
+            raise ValueError("%s needs a file=... path (got kwargs %s)"
+                             % (type(self).__name__, sorted(kwargs)))
         self.image_format = kwargs.get("image_format", "png")
         self.content = None
+
+    @property
+    def image_formats(self):
+        return (self.image_format,)
 
     def render_content(self, info):
         import jinja2

@@ -13,6 +13,7 @@ from veles_tpu.publishing.backend import Backend
 
 class PdfBackend(Backend):
     MAPPING = "pdf"
+    image_formats = ("png",)
 
     def __init__(self, **kwargs):
         super(PdfBackend, self).__init__(**kwargs)

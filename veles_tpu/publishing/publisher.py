@@ -53,7 +53,12 @@ class Publisher(Unit, TriviallyDistributable):
                   sorted(self._backend_instances) or "no backends")
         for name, backend in self._backend_instances.items():
             self.debug("rendering %s...", name)
-            backend.render(info)
+            try:
+                backend.render(info)
+            except Exception as e:
+                # a broken template must not lose the other reports (or
+                # crash the workflow at the very end of training)
+                self.warning("backend %s failed: %s", name, e)
 
     # -- info gathering ----------------------------------------------------
 
@@ -71,9 +76,7 @@ class Publisher(Unit, TriviallyDistributable):
                                  platform.python_version()),
             "pid": os.getpid(),
             "workflow_graph": workflow.generate_graph(),
-            "unit_run_times_by_name": {
-                unit.name: (unit.run_time, unit.run_calls)
-                for unit in workflow.units},
+            "unit_run_times_by_name": self._run_times_by_unit(),
             "unit_run_times_by_class": self._run_times_by_class(),
             "results": workflow.gather_results(),
             "plots": self._gather_plots() if self.include_plots else {},
@@ -103,6 +106,22 @@ class Publisher(Unit, TriviallyDistributable):
                 info["labels"] = tuple(mapping)
         return info
 
+    @staticmethod
+    def _uniquify(name, seen):
+        """Unit names are not unique; reports must not lose rows."""
+        if name not in seen:
+            seen[name] = 1
+            return name
+        seen[name] += 1
+        return "%s#%d" % (name, seen[name])
+
+    def _run_times_by_unit(self):
+        seen, stats = {}, {}
+        for unit in self.workflow.units:
+            stats[self._uniquify(unit.name, seen)] = (unit.run_time,
+                                                      unit.run_calls)
+        return stats
+
     def _run_times_by_class(self):
         stats = {}
         for unit in self.workflow.units:
@@ -111,9 +130,15 @@ class Publisher(Unit, TriviallyDistributable):
             stats[key] = (secs + unit.run_time, calls + unit.run_calls)
         return stats
 
+    def _image_formats(self):
+        """Only render what the configured backends will read."""
+        formats = set()
+        for backend in self._backend_instances.values():
+            formats.update(getattr(backend, "image_formats", ("png",)))
+        return sorted(formats) or ["png"]
+
     def _gather_plots(self):
-        """Render every plotter in the workflow to png+svg bytes
-        (``publisher.py:237-254``)."""
+        """Render every plotter in the workflow (``publisher.py:237-254``)."""
         from veles_tpu.plotter import Plotter
         plots = {}
         try:
@@ -123,23 +148,29 @@ class Publisher(Unit, TriviallyDistributable):
         except ImportError:  # pragma: no cover - matplotlib is baked in
             self.warning("matplotlib unavailable; skipping plots")
             return plots
+        formats = self._image_formats()
+        seen = {}
         for unit in self.workflow.units_in_dependency_order:
             if not isinstance(unit, Plotter) or not unit.redraw_plot:
                 continue
             figure = Figure()
             try:
-                # fill() grabs the current linked-attribute state — the
-                # reference does the same so reports work even when live
-                # plotting was disabled during the run
-                unit.fill()
+                # a plotter that filled during the run already holds its
+                # accumulated state — calling fill() again would append
+                # a duplicate point (or, with clear_plot, erase the
+                # curve). Only never-filled plotters need one fill() to
+                # capture the current linked-attribute state.
+                if not getattr(unit, "has_filled", False):
+                    unit.fill()
                 unit.redraw(figure)
             except Exception as e:
                 self.warning("plotter %s failed to render: %s",
                              unit.name, e)
                 continue
-            plots[unit.name] = formats = {}
-            for fmt in ("png", "svg"):
+            rendered_formats = {}
+            for fmt in formats:
                 rendered = io.BytesIO()
                 figure.savefig(rendered, format=fmt)
-                formats[fmt] = rendered.getvalue()
+                rendered_formats[fmt] = rendered.getvalue()
+            plots[self._uniquify(unit.name, seen)] = rendered_formats
         return plots
