@@ -45,11 +45,18 @@ void ThreadPoolEngine::WorkerLoop() {
 
 void ThreadPoolEngine::ParallelFor(
     int64_t count, const std::function<void(int64_t)>& fn) {
+  ParallelShards(count, [&fn](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) fn(i);
+  });
+}
+
+void ThreadPoolEngine::ParallelShards(
+    int64_t count, const std::function<void(int64_t, int64_t)>& fn) {
   if (count <= 0) return;
   int64_t shards =
       std::min<int64_t>(count, static_cast<int64_t>(threads_.size()));
   if (shards <= 1) {
-    for (int64_t i = 0; i < count; ++i) fn(i);
+    fn(0, count);
     return;
   }
   {
@@ -58,9 +65,7 @@ void ThreadPoolEngine::ParallelFor(
     for (int64_t s = 0; s < shards; ++s) {
       int64_t begin = count * s / shards;
       int64_t end = count * (s + 1) / shards;
-      queue_.push([fn, begin, end] {
-        for (int64_t i = begin; i < end; ++i) fn(i);
-      });
+      queue_.push([&fn, begin, end] { fn(begin, end); });
     }
   }
   work_cv_.notify_all();

@@ -21,6 +21,12 @@ class ThreadPoolEngine {
   // Runs fn(i) for i in [0, count) across the pool and waits.
   void ParallelFor(int64_t count, const std::function<void(int64_t)>& fn);
 
+  // Runs fn(begin, end) once per worker shard — lets callers hoist
+  // per-shard scratch allocations out of the element loop.
+  void ParallelShards(
+      int64_t count,
+      const std::function<void(int64_t, int64_t)>& fn);
+
   int workers() const { return static_cast<int>(threads_.size()); }
 
  private:

@@ -53,11 +53,16 @@ NpyArray ParseNpy(const std::vector<char>& bytes) {
     header_len = static_cast<uint8_t>(bytes[8]) |
                  (static_cast<uint8_t>(bytes[9]) << 8);
     header_at = 10;
-  } else {
+  } else if (major == 2 || major == 3) {
+    if (bytes.size() < 12) {
+      throw std::runtime_error("truncated .npy header");
+    }
     uint32_t len;
     std::memcpy(&len, bytes.data() + 8, 4);
     header_len = len;
     header_at = 12;
+  } else {
+    throw std::runtime_error("unsupported .npy version");
   }
   if (header_at + header_len > bytes.size()) {
     throw std::runtime_error("truncated .npy header");

@@ -42,6 +42,15 @@ ActFn ActivationByName(const std::string& name) {
   throw std::runtime_error("unknown activation: " + name);
 }
 
+// sincos works on channel indices (odd -> sin, even -> cos), so it
+// can't be a scalar ActFn; applied over rows whose last dim is known
+void ApplySinCos(float* data, int64_t count, int64_t last_dim) {
+  for (int64_t i = 0; i < count; ++i) {
+    data[i] = (i % last_dim) % 2 == 1 ? std::sin(data[i])
+                                      : std::cos(data[i]);
+  }
+}
+
 void Softmax(float* row, int64_t n) {
   float mx = row[0];
   for (int64_t i = 1; i < n; ++i) mx = std::max(mx, row[i]);
@@ -71,7 +80,7 @@ class All2AllUnit : public Unit {
       throw std::runtime_error("All2All input/weights shape mismatch");
     }
     activation_name_ = StrParam("activation", "linear");
-    if (activation_name_ != "softmax") {
+    if (activation_name_ != "softmax" && activation_name_ != "sincos") {
       act_ = ActivationByName(activation_name_);
     }
     output_shape_ = IntListParam("output_sample_shape");
@@ -106,6 +115,8 @@ class All2AllUnit : public Unit {
       }
       if (activation_name_ == "softmax") {
         Softmax(out_row, out_features_);
+      } else if (activation_name_ == "sincos") {
+        ApplySinCos(out_row, out_features_, out_features_);
       } else {
         for (int64_t j = 0; j < out_features_; ++j) {
           out_row[j] = act_(out_row[j]);
@@ -340,9 +351,12 @@ class LrnUnit : public Unit {
         const float* px = x + p * channels_;
         float* py = y + p * channels_;
         for (int64_t ci = 0; ci < channels_; ++ci) {
+          // the JAX reference sums exactly n shifted slices of a
+          // half=n/2 zero-padded axis: window = [ci-half, ci-half+n-1]
+          // (asymmetric for even n) — mirror that, not ci±half
           float window = 0.0f;
           int64_t lo = std::max<int64_t>(0, ci - half);
-          int64_t hi = std::min(channels_ - 1, ci + half);
+          int64_t hi = std::min(channels_ - 1, ci - half + n_ - 1);
           for (int64_t j = lo; j <= hi; ++j) {
             window += px[j] * px[j];
           }
@@ -366,17 +380,26 @@ class ActivationUnitImpl : public Unit {
   Shape Initialize(const Shape& input_shape) override {
     input_shape_ = input_shape;
     output_shape_ = input_shape;
-    act_ = ActivationByName(StrParam("activation", "linear"));
+    name_ = StrParam("activation", "linear");
+    if (name_ != "sincos") {
+      act_ = ActivationByName(name_);
+    }
     return output_shape_;
   }
 
   void Execute(const float* input, float* output,
                int64_t batch) const override {
     int64_t count = batch * ShapeSize(input_shape_);
+    if (name_ == "sincos") {
+      std::memcpy(output, input, count * sizeof(float));
+      ApplySinCos(output, count, input_shape_.back());
+      return;
+    }
     for (int64_t i = 0; i < count; ++i) output[i] = act_(input[i]);
   }
 
  private:
+  std::string name_;
   ActFn act_ = ActLinear;
 };
 
@@ -421,6 +444,35 @@ void RegisterBuiltinUnits() {
   f.Register("LRNormalizerForward", Make<LrnUnit>);
   f.Register("ActivationUnit", Make<ActivationUnitImpl>);
   f.Register("DropoutForward", Make<IdentityUnit>);
+  // stable uuid5(namespace, class name) ids matching the Python-side
+  // UnitRegistry (veles_tpu/unit_registry.py); regenerate with:
+  //   python -c "import uuid; ns=uuid.UUID('6ba7b812-9dad-11d1-80b4-
+  //   00c04fd430c8'); print(uuid.uuid5(ns, 'All2All'))" etc.
+  f.RegisterUuid("566dfbe9-c8bb-537c-bb78-c7aaa8a26c68", "All2All");
+  f.RegisterUuid("33faa373-fa85-505a-9ecc-ff8ccceec52a", "All2AllTanh");
+  f.RegisterUuid("1b65bb92-db95-5208-a23c-866194ea7160", "All2AllRELU");
+  f.RegisterUuid("d1e6ae9f-5298-50be-82db-27dd0c0d10c3",
+                 "All2AllStrictRELU");
+  f.RegisterUuid("865cf10f-495b-5238-9cb6-c2f9464f2ce2",
+                 "All2AllSigmoid");
+  f.RegisterUuid("e3f0f557-d763-54a6-ab02-13700a47f98d",
+                 "All2AllSoftmax");
+  f.RegisterUuid("70497426-380b-558a-9812-b21bc9af9115", "Conv");
+  f.RegisterUuid("d8b6ba41-4e7e-52fb-a607-e4a7d2be6e63", "ConvTanh");
+  f.RegisterUuid("7a3a1752-5e26-5f63-898b-e29cc9c395c2", "ConvRELU");
+  f.RegisterUuid("b0cf5c0d-c376-5657-af07-c77d728ce85d",
+                 "ConvStrictRELU");
+  f.RegisterUuid("1cb00dfb-daf2-57bb-95a9-bebecb4c9699", "ConvSigmoid");
+  f.RegisterUuid("c5384cdb-2799-5687-b15d-c30e3268b499", "MaxPooling");
+  f.RegisterUuid("b2a139d6-81ae-50ee-bf9c-381d0aa20054",
+                 "MaxAbsPooling");
+  f.RegisterUuid("40ddab7d-d9b6-57cb-aeaf-32c6df4a4bb0", "AvgPooling");
+  f.RegisterUuid("fce7f45f-8c02-57d8-b193-ef6c29278a6c",
+                 "LRNormalizerForward");
+  f.RegisterUuid("de91869f-3aa3-50d3-bf9d-e27ffc6ce77a",
+                 "ActivationUnit");
+  f.RegisterUuid("be4621cf-8dde-51b6-ad4d-9e7a1ded811b",
+                 "DropoutForward");
 }
 
 }  // namespace veles_native

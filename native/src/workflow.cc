@@ -43,17 +43,19 @@ const Shape& Workflow::output_shape() const {
 std::vector<float> Workflow::Run(const float* input, int64_t batch) const {
   if (!initialized_) throw std::runtime_error("Initialize() first");
   std::vector<float> result(batch * output_size());
-  // per-sample arena keeps every worker's scratch independent, so the
-  // batch shards freely across the pool
-  engine_->ParallelFor(batch, [&](int64_t b) {
+  // one arena per worker shard (not per sample): scratch is reused
+  // across the shard's samples, which is the memory planner's point
+  engine_->ParallelShards(batch, [&](int64_t begin, int64_t end) {
     std::vector<float> arena(arena_size_);
-    const float* current = input + b * input_size();
-    for (size_t i = 0; i < units_.size(); ++i) {
-      float* out = i + 1 == units_.size()
-                       ? result.data() + b * output_size()
-                       : arena.data() + offsets_[i];
-      units_[i]->Execute(current, out, 1);
-      current = out;
+    for (int64_t b = begin; b < end; ++b) {
+      const float* current = input + b * input_size();
+      for (size_t i = 0; i < units_.size(); ++i) {
+        float* out = i + 1 == units_.size()
+                         ? result.data() + b * output_size()
+                         : arena.data() + offsets_[i];
+        units_[i]->Execute(current, out, 1);
+        current = out;
+      }
     }
   });
   return result;

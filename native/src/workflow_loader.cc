@@ -38,16 +38,23 @@ std::unique_ptr<Workflow> LoadWorkflow(
 
   for (const JsonValue& unit_json : wf_json.at("units").as_array()) {
     const JsonValue& cls = unit_json.at("class");
+    const std::string& cls_name = cls.at("name").as_string();
     std::unique_ptr<Unit> unit;
-    // class name first, exported UUID as the fallback key — both are
-    // registered (libVeles keyed on UUID only)
+    // class name first; the exported uuid5 id is the fallback key
+    // (both are registered — libVeles keyed on UUID only). A miss on
+    // both reports the CLASS name, which is the actionable one.
     try {
-      unit = UnitFactory::Instance().Create(cls.at("name").as_string());
+      unit = UnitFactory::Instance().Create(cls_name);
     } catch (const std::runtime_error&) {
-      if (cls.contains("uuid") && cls.at("uuid").is_string()) {
-        unit = UnitFactory::Instance().Create(cls.at("uuid").as_string());
-      } else {
-        throw;
+      try {
+        if (cls.contains("uuid") && cls.at("uuid").is_string()) {
+          unit =
+              UnitFactory::Instance().Create(cls.at("uuid").as_string());
+        }
+      } catch (const std::runtime_error&) {
+      }
+      if (!unit) {
+        throw std::runtime_error("unknown unit type: " + cls_name);
       }
     }
     for (const auto& kv : unit_json.at("data").as_object()) {

@@ -157,6 +157,74 @@ def test_cli_runner_end_to_end(native_lib, tmp_path):
     numpy.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-6)
 
 
+def _single_unit_workflow(unit_factory):
+    """Wrap one forward unit in a minimal exportable workflow."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.dummy import DummyLauncher
+    wf = AcceleratedWorkflow(DummyLauncher())
+    wf.forwards = [unit_factory(wf)]
+    wf.loader = None
+    return wf
+
+
+def test_native_lrn_even_n_matches_jax(native_lib, tmp_path):
+    """Even-n LRN windows are asymmetric in the JAX reference — the
+    native kernel must mirror that (regression)."""
+    import jax.numpy as jnp
+    from veles_tpu.export.native import NativeWorkflow
+    from veles_tpu.nn.normalization import LRNormalizerForward, lrn
+    wf = _single_unit_workflow(
+        lambda w: LRNormalizerForward(w, n=4, k=1.5, alpha=0.3, beta=0.6))
+    path = wf.package_export(str(tmp_path / "lrn"))
+    # patch input_shape by hand (no loader in the minimal workflow)
+    contents, _ = load_package_info(path)
+    assert contents["input_shape"] is None
+    rng = numpy.random.RandomState(3)
+    batch = rng.rand(4, 2, 2, 6).astype(numpy.float32)
+    expect = numpy.asarray(lrn(jnp.asarray(batch), 1.5, 0.3, 0.6, 4))
+    # the minimal workflow has no loader, so write input_shape by hand
+    import json as jsonlib
+    with open(str(tmp_path / "lrn" / "contents.json")) as f:
+        doc = jsonlib.load(f)
+    doc["input_shape"] = [4, 2, 2, 6]
+    with open(str(tmp_path / "lrn" / "contents.json"), "w") as f:
+        jsonlib.dump(doc, f)
+    with NativeWorkflow(str(tmp_path / "lrn")) as native:
+        got = native.run(batch)
+    numpy.testing.assert_allclose(got, expect.reshape(4, -1),
+                                  rtol=1e-5, atol=1e-6)
+
+
+def test_native_sincos_activation(native_lib, tmp_path):
+    import jax.numpy as jnp
+    from veles_tpu.export.native import NativeWorkflow
+    from veles_tpu.nn.activation import ActivationUnit, sincos
+    wf = _single_unit_workflow(
+        lambda w: ActivationUnit(w, activation="sincos"))
+    path = wf.package_export(str(tmp_path / "sc"))
+    import json as jsonlib
+    with open(str(tmp_path / "sc" / "contents.json")) as f:
+        doc = jsonlib.load(f)
+    doc["input_shape"] = [2, 3, 5]
+    with open(str(tmp_path / "sc" / "contents.json"), "w") as f:
+        jsonlib.dump(doc, f)
+    rng = numpy.random.RandomState(4)
+    batch = rng.rand(2, 3, 5).astype(numpy.float32)
+    expect = numpy.asarray(sincos(jnp.asarray(batch)))
+    with NativeWorkflow(str(tmp_path / "sc")) as native:
+        got = native.run(batch)
+    numpy.testing.assert_allclose(got, expect.reshape(2, -1),
+                                  rtol=1e-5, atol=1e-6)
+
+
+def test_conv_sincos_export_rejected(tmp_path):
+    from veles_tpu.nn.conv import Conv
+    wf = _single_unit_workflow(
+        lambda w: Conv(w, n_kernels=2, kx=2, ky=2, activation="sincos"))
+    with pytest.raises(NotImplementedError, match="sincos"):
+        wf.package_export(str(tmp_path / "bad"))
+
+
 def test_cpp_unit_tests(native_lib):
     from veles_tpu.export.native import test_binary_path
     proc = subprocess.run([test_binary_path()], capture_output=True,

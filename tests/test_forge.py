@@ -1,0 +1,163 @@
+"""Forge model repository end-to-end
+(reference: tests/test_forge_server.py + test_forge_client.py)."""
+
+import json
+import os
+
+import pytest
+
+from veles_tpu.forge import ForgeClient, ForgeServer
+
+
+def _make_package(tmp_path, name="mnist-fc", version="1.0", author="me"):
+    pkg = tmp_path / ("pkg-%s-%s" % (name, version))
+    pkg.mkdir(exist_ok=True)
+    (pkg / "manifest.json").write_text(json.dumps({
+        "name": name, "version": version, "author": author,
+        "short_description": "a model", "workflow": "workflow.py",
+        "config": "config.py"}))
+    (pkg / "workflow.py").write_text("WORKFLOW = %r\n" % version)
+    (pkg / "config.py").write_text("root = {}\n")
+    (pkg / "weights.npy").write_bytes(b"\x93NUMPY fake")
+    return str(pkg)
+
+
+@pytest.fixture
+def forge(tmp_path):
+    server = ForgeServer(str(tmp_path / "storage"), port=0,
+                         token="sekret").start()
+    client = ForgeClient("127.0.0.1:%d" % server.port, token="sekret")
+    try:
+        yield server, client, tmp_path
+    finally:
+        server.stop()
+
+
+def test_upload_list_details_fetch_delete(forge):
+    server, client, tmp_path = forge
+    client.upload(_make_package(tmp_path))
+    client.upload(_make_package(tmp_path, version="1.1"))
+    client.upload(_make_package(tmp_path, name="cifar", author="you"))
+
+    models = client.list()
+    assert [m["name"] for m in models] == ["cifar", "mnist-fc"]
+    latest = next(m for m in models if m["name"] == "mnist-fc")
+    assert latest["version"] == "1.1" and latest["author"] == "me"
+
+    details = client.details("mnist-fc")
+    assert details["manifest"]["workflow"] == "workflow.py"
+    assert [v["version"] for v in details["versions"]] == ["1.0", "1.1"]
+
+    dest = tmp_path / "fetched"
+    got = client.fetch("mnist-fc", str(dest))
+    assert got == "1.1"
+    assert (dest / "workflow.py").read_text() == "WORKFLOW = '1.1'\n"
+    assert (dest / "weights.npy").exists()
+
+    dest_old = tmp_path / "fetched-1.0"
+    assert client.fetch("mnist-fc", str(dest_old), version="1.0") == "1.0"
+    assert (dest_old / "workflow.py").read_text() == "WORKFLOW = '1.0'\n"
+
+    client.delete("mnist-fc", version="1.1")
+    assert client.details("mnist-fc")["versions"][-1]["version"] == "1.0"
+    client.delete("mnist-fc")
+    assert [m["name"] for m in client.list()] == ["cifar"]
+
+
+def test_duplicate_version_rejected(forge):
+    server, client, tmp_path = forge
+    client.upload(_make_package(tmp_path))
+    with pytest.raises(RuntimeError, match="already exists"):
+        client.upload(_make_package(tmp_path))
+
+
+def test_token_required_for_mutations(forge):
+    server, client, tmp_path = forge
+    client.upload(_make_package(tmp_path))
+    anonymous = ForgeClient("127.0.0.1:%d" % server.port)
+    # reads are public
+    assert anonymous.list()
+    assert anonymous.details("mnist-fc")["name"] == "mnist-fc"
+    # writes are not
+    with pytest.raises(RuntimeError, match="token"):
+        anonymous.upload(_make_package(tmp_path, version="2.0"))
+    with pytest.raises(RuntimeError, match="token"):
+        anonymous.delete("mnist-fc")
+
+
+def test_missing_model_is_404(forge):
+    server, client, tmp_path = forge
+    with pytest.raises(RuntimeError, match="no such model"):
+        client.details("nope")
+    with pytest.raises(RuntimeError, match="no such model"):
+        client.fetch("nope", "/tmp/nowhere")
+    client.upload(_make_package(tmp_path))
+    with pytest.raises(RuntimeError, match="no version"):
+        client.fetch("mnist-fc", "/tmp/nowhere", version="9.9")
+
+
+def test_bad_packages_rejected(forge):
+    server, client, tmp_path = forge
+    # no manifest
+    with pytest.raises(ValueError):
+        server.upload(b"not a tar at all", token="sekret")
+    import io
+    import tarfile
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        data = b"{}"
+        info = tarfile.TarInfo("stuff.txt")
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+    with pytest.raises(ValueError, match="manifest"):
+        server.upload(buf.getvalue(), token="sekret")
+    # path traversal
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        manifest = json.dumps({"name": "evil", "version": "1"}).encode()
+        info = tarfile.TarInfo("manifest.json")
+        info.size = len(manifest)
+        tar.addfile(info, io.BytesIO(manifest))
+        info = tarfile.TarInfo("../escape.txt")
+        info.size = 0
+        tar.addfile(info, io.BytesIO(b""))
+    with pytest.raises(ValueError, match="unsafe"):
+        server.upload(buf.getvalue(), token="sekret")
+    # bad names
+    for bad in ("", "..", "a/b", "-x", "a b"):
+        with pytest.raises(ValueError):
+            from veles_tpu.forge.server import validate_name
+            validate_name(bad)
+
+
+def test_exported_model_through_forge(forge, tmp_path):
+    """The real flow: train → package_export → upload → fetch → native."""
+    import numpy
+    from veles_tpu import prng
+    from veles_tpu.backends import Device
+    from veles_tpu.models.mnist import MnistWorkflow
+    server, client, base = forge
+
+    def provider():
+        rng = numpy.random.RandomState(0)
+        return (rng.rand(20, 6, 6).astype(numpy.float32),
+                rng.randint(0, 10, 20).astype(numpy.int32),
+                rng.rand(10, 6, 6).astype(numpy.float32),
+                rng.randint(0, 10, 10).astype(numpy.int32))
+
+    prng.get().seed(51)
+    prng.get("loader").seed(52)
+    wf = MnistWorkflow(provider=provider, layers=(8,), minibatch_size=10,
+                       max_epochs=1)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    pkg_dir = tmp_path / "package"
+    wf.package_export(str(pkg_dir))
+    with open(pkg_dir / "manifest.json", "w") as f:
+        json.dump({"name": "trained-mnist", "version": "1.0",
+                   "author": "ci", "export": "contents.json"}, f)
+    client.upload(str(pkg_dir))
+    dest = tmp_path / "roundtrip"
+    client.fetch("trained-mnist", str(dest))
+    assert (dest / "contents.json").exists()
+    assert any(fn.startswith("@") for fn in os.listdir(dest))

@@ -65,9 +65,19 @@ def _export_softmax(unit):
     return data
 
 
+#: activations the native Conv kernel can apply per-scalar; sincos
+#: needs channel indices and is only wired for All2All/ActivationUnit
+_CONV_ACTIVATIONS = ("linear", "tanh", "sigmoid", "relu", "strict_relu",
+                     "leaky_relu", "log")
+
+
 @exporter("Conv", "ConvTanh", "ConvRELU", "ConvStrictRELU", "ConvSigmoid")
 def _export_conv(unit):
     data = _common(unit)
+    if unit.activation_name not in _CONV_ACTIVATIONS:
+        raise NotImplementedError(
+            "Conv activation %r is not supported by the native runtime"
+            % unit.activation_name)
     data["activation"] = unit.activation_name
     data["n_kernels"] = unit.n_kernels
     data["kx"], data["ky"] = unit.kx, unit.ky
@@ -162,11 +172,21 @@ def _stablehlo_blob(workflow, input_shape, precision):
             {k: jnp.asarray(v) for k, v in fwd.param_values().items()}
             if fwd.has_weights else {}
             for fwd in forwards)
-        x = jax.ShapeDtypeStruct(tuple(input_shape), jnp.dtype(precision))
-        exported = jax_export.export(jax.jit(forward))(
-            tuple(jax.tree.map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), p)
-                for p in params), x)
+        param_shapes = tuple(
+            jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                         p)
+            for p in params)
+        sample_shape = tuple(input_shape[1:])
+        dtype = jnp.dtype(precision)
+        try:
+            # symbolic batch: the artifact must serve ANY batch size,
+            # not just the training minibatch it was exported from
+            (b,) = jax_export.symbolic_shape("b")
+            x = jax.ShapeDtypeStruct((b,) + sample_shape, dtype)
+            exported = jax_export.export(jax.jit(forward))(param_shapes, x)
+        except Exception:
+            x = jax.ShapeDtypeStruct(tuple(input_shape), dtype)
+            exported = jax_export.export(jax.jit(forward))(param_shapes, x)
         return exported.serialize()
     except Exception:
         return None

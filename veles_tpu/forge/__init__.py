@@ -1,0 +1,15 @@
+"""Forge: the model repository (re-designs ``veles/forge/``).
+
+A Forge server stores versioned model packages — workflow file, config
+file, weights/export artifacts, described by a ``manifest.json`` — and
+serves the reference's protocol surface: ``/service?query=list|
+details|delete``, ``/fetch?name=&version=``, ``POST /upload``
+(``forge_server.py:103-427``, ``forge_client.py:91-367``). The
+reference versioned through server-side git repositories and confirmed
+authors by email; here versions are explicit directory snapshots with
+an upload journal and auth is a shared token — same capability, much
+less machinery.
+"""
+
+from veles_tpu.forge.client import ForgeClient  # noqa: F401
+from veles_tpu.forge.server import ForgeServer  # noqa: F401

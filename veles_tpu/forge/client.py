@@ -1,0 +1,145 @@
+"""Forge client (``veles/forge/forge_client.py:91-367``).
+
+Programmatic API + CLI: ``python -m veles_tpu.forge.client
+list|details|fetch|upload|delete ...``. Packages are directories with a
+``manifest.json`` naming the model, version, workflow/config files.
+"""
+
+import argparse
+import io
+import json
+import os
+import tarfile
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+
+
+class ForgeClient(Logger):
+    def __init__(self, base, token=None):
+        super(ForgeClient, self).__init__()
+        if "://" not in base:
+            base = "http://" + base
+        self.base = base.rstrip("/")
+        self.token = token
+
+    # -- helpers -----------------------------------------------------------
+
+    @property
+    def _service(self):
+        return "%s/%s" % (self.base,
+                          root.common.forge.get("service_name", "forge"))
+
+    def _get_json(self, url):
+        try:
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(self._http_error(e))
+
+    @staticmethod
+    def _http_error(e):
+        try:
+            return json.loads(e.read()).get("error", str(e))
+        except Exception:
+            return str(e)
+
+    # -- operations --------------------------------------------------------
+
+    def list(self):
+        return self._get_json(self._service + "?query=list")
+
+    def details(self, name):
+        return self._get_json(
+            "%s?query=details&name=%s" %
+            (self._service, urllib.parse.quote(name)))
+
+    def delete(self, name, version=None):
+        url = "%s?query=delete&name=%s" % (self._service,
+                                           urllib.parse.quote(name))
+        if version:
+            url += "&version=" + urllib.parse.quote(version)
+        if self.token:
+            url += "&token=" + urllib.parse.quote(self.token)
+        return self._get_json(url)
+
+    def upload(self, path):
+        """Upload a package directory (must contain manifest.json)."""
+        manifest_path = os.path.join(path, "manifest.json")
+        with open(manifest_path) as f:
+            manifest = json.load(f)  # fail fast on bad packages
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            for fn in sorted(os.listdir(path)):
+                # recursive: packages may carry plots/, data/ subtrees
+                tar.add(os.path.join(path, fn), arcname=fn)
+        url = self.base + "/upload"
+        if self.token:
+            url += "?token=" + urllib.parse.quote(self.token)
+        request = urllib.request.Request(
+            url, data=buf.getvalue(),
+            headers={"Content-Type": "application/x-tar"})
+        try:
+            with urllib.request.urlopen(request, timeout=60) as resp:
+                reply = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(self._http_error(e))
+        self.info("uploaded %s version %s", reply["name"],
+                  reply["version"])
+        return reply
+
+    def fetch(self, name, dest, version=None):
+        """Download + unpack a model into ``dest``; returns version."""
+        url = "%s/fetch?name=%s" % (self.base, urllib.parse.quote(name))
+        if version:
+            url += "&version=" + urllib.parse.quote(version)
+        try:
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                got_version = resp.headers.get("X-Forge-Version")
+                blob = resp.read()
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(self._http_error(e))
+        os.makedirs(dest, exist_ok=True)
+        with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
+            for member in tar.getmembers():
+                if member.name.startswith(("/", "..")) or \
+                        ".." in member.name.split("/"):
+                    raise ValueError("unsafe member: %s" % member.name)
+            tar.extractall(dest, filter="data")
+        self.info("fetched %s version %s into %s", name, got_version,
+                  dest)
+        return got_version
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="veles_tpu forge client")
+    parser.add_argument("action",
+                        choices=("list", "details", "fetch", "upload",
+                                 "delete"))
+    parser.add_argument("-s", "--server", required=True,
+                        help="forge server, host:port or URL")
+    parser.add_argument("-n", "--name", default=None)
+    parser.add_argument("-v", "--version", default=None)
+    parser.add_argument("-d", "--directory", default=".",
+                        help="package dir (upload) / destination (fetch)")
+    parser.add_argument("--token", default=None)
+    args = parser.parse_args(argv)
+    client = ForgeClient(args.server, token=args.token)
+    if args.action == "list":
+        print(json.dumps(client.list(), indent=2))
+    elif args.action == "details":
+        print(json.dumps(client.details(args.name), indent=2))
+    elif args.action == "fetch":
+        client.fetch(args.name, args.directory, version=args.version)
+    elif args.action == "upload":
+        client.upload(args.directory)
+    elif args.action == "delete":
+        client.delete(args.name, version=args.version)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,293 @@
+"""Forge server (``veles/forge/forge_server.py:103-427``)."""
+
+import io
+import json
+import os
+import re
+import shutil
+import tarfile
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+
+#: model/version names must stay inside the storage tree
+_SAFE_NAME = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+def validate_name(name):
+    if not name or not _SAFE_NAME.match(name) or ".." in name:
+        raise ValueError("invalid name: %r" % (name,))
+    return name
+
+
+class ForgeServer(Logger):
+    """Stores versioned packages under ``storage_dir``.
+
+    Layout: ``<storage>/<model>/<version>/*`` + per-model
+    ``meta.json`` (version journal, latest pointer).
+    """
+
+    def __init__(self, storage_dir, host="127.0.0.1", port=0, token=None):
+        super(ForgeServer, self).__init__()
+        self.storage_dir = os.path.abspath(storage_dir)
+        os.makedirs(self.storage_dir, exist_ok=True)
+        self.token = token
+        self._lock = threading.RLock()
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.owner = self
+        self._server.daemon_threads = True
+        self.address = self._server.server_address
+        self._thread = None
+
+    @property
+    def port(self):
+        return self.address[1]
+
+    # -- storage -----------------------------------------------------------
+
+    def _meta_path(self, name):
+        return os.path.join(self.storage_dir, name, "meta.json")
+
+    def _read_meta(self, name):
+        try:
+            with open(self._meta_path(name)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def _write_meta(self, name, meta):
+        with open(self._meta_path(name), "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True)
+
+    def list_models(self):
+        with self._lock:
+            models = []
+            for name in sorted(os.listdir(self.storage_dir)):
+                meta = self._read_meta(name)
+                if meta is None:
+                    continue
+                latest = meta["versions"][-1]
+                models.append({
+                    "name": name,
+                    "author": latest.get("author", ""),
+                    "description": latest.get("short_description", ""),
+                    "version": latest["version"],
+                    "updated": latest["uploaded"],
+                })
+            return models
+
+    def details(self, name):
+        validate_name(name)
+        with self._lock:
+            meta = self._read_meta(name)
+            if meta is None:
+                raise KeyError("no such model: %s" % name)
+            latest = meta["versions"][-1]
+            manifest_path = os.path.join(
+                self.storage_dir, name, latest["version"], "manifest.json")
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            return {"name": name, "manifest": manifest,
+                    "versions": meta["versions"]}
+
+    def upload(self, blob, token=None):
+        self._check_token(token)
+        try:
+            tar = tarfile.open(fileobj=io.BytesIO(blob))
+        except tarfile.TarError as e:
+            raise ValueError("not a tar package: %s" % e)
+        with tar:
+            names = tar.getnames()
+            if "manifest.json" not in names:
+                raise ValueError("package has no manifest.json")
+            manifest = json.loads(
+                tar.extractfile("manifest.json").read())
+            name = validate_name(manifest.get("name"))
+            version = validate_name(str(manifest.get("version", "1.0")))
+            for member in tar.getmembers():
+                # refuse path traversal / links before extraction
+                if member.name.startswith(("/", "..")) or \
+                        ".." in member.name.split("/") or \
+                        not (member.isreg() or member.isdir()):
+                    raise ValueError("unsafe member: %s" % member.name)
+            with self._lock:
+                meta = self._read_meta(name) or {"versions": []}
+                if any(v["version"] == version
+                       for v in meta["versions"]):
+                    raise ValueError(
+                        "%s version %s already exists" % (name, version))
+                target = os.path.join(self.storage_dir, name, version)
+                os.makedirs(target, exist_ok=True)
+                tar.extractall(target, filter="data")
+                meta["versions"].append({
+                    "version": version,
+                    "author": manifest.get("author", ""),
+                    "short_description":
+                        manifest.get("short_description", ""),
+                    "uploaded": time.time(),
+                })
+                self._write_meta(name, meta)
+        self.info("uploaded %s version %s", name, version)
+        return {"name": name, "version": version}
+
+    def fetch(self, name, version=None):
+        validate_name(name)
+        with self._lock:
+            meta = self._read_meta(name)
+            if meta is None:
+                raise KeyError("no such model: %s" % name)
+            if version is None or version == "latest":
+                version = meta["versions"][-1]["version"]
+            else:
+                validate_name(version)
+                if not any(v["version"] == version
+                           for v in meta["versions"]):
+                    raise KeyError("no version %s of %s" % (version, name))
+            source = os.path.join(self.storage_dir, name, version)
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w") as tar:
+                for fn in sorted(os.listdir(source)):
+                    tar.add(os.path.join(source, fn), arcname=fn)
+            return buf.getvalue(), version
+
+    def delete(self, name, token=None, version=None):
+        self._check_token(token)
+        validate_name(name)
+        with self._lock:
+            meta = self._read_meta(name)
+            if meta is None:
+                raise KeyError("no such model: %s" % name)
+            if version is None:
+                shutil.rmtree(os.path.join(self.storage_dir, name))
+                self.info("deleted %s (all versions)", name)
+                return {"deleted": name}
+            validate_name(version)
+            kept = [v for v in meta["versions"] if v["version"] != version]
+            if len(kept) == len(meta["versions"]):
+                raise KeyError("no version %s of %s" % (version, name))
+            shutil.rmtree(os.path.join(self.storage_dir, name, version))
+            if kept:
+                meta["versions"] = kept
+                self._write_meta(name, meta)
+            else:
+                shutil.rmtree(os.path.join(self.storage_dir, name))
+            return {"deleted": name, "version": version}
+
+    def _check_token(self, token):
+        if self.token is not None and token != self.token:
+            raise PermissionError("bad or missing token")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="forge")
+        self._thread.start()
+        self.info("forge serving %s on %s:%d", self.storage_dir,
+                  *self.address)
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        self.server.owner.debug("http: " + fmt, *args)
+
+    def _reply(self, body, code=200, ctype="application/json"):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, e):
+        code = {KeyError: 404, PermissionError: 403}.get(type(e), 400)
+        message = str(e).strip("'") or type(e).__name__
+        self._reply({"error": message}, code=code)
+
+    def do_GET(self):
+        parsed = urllib.parse.urlparse(self.path)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        owner = self.server.owner
+        service = "/" + root.common.forge.get("service_name", "forge")
+        try:
+            if parsed.path == service:
+                q = query.get("query")
+                if q == "list":
+                    self._reply(owner.list_models())
+                elif q == "details":
+                    self._reply(owner.details(query.get("name", "")))
+                elif q == "delete":
+                    self._reply(owner.delete(query.get("name", ""),
+                                             token=query.get("token"),
+                                             version=query.get("version")))
+                else:
+                    raise ValueError("unknown query %r" % q)
+            elif parsed.path == "/fetch":
+                blob, version = owner.fetch(query.get("name", ""),
+                                            query.get("version"))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-tar")
+                self.send_header("X-Forge-Version", version)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+            else:
+                self._reply({"error": "not found"}, code=404)
+        except Exception as e:
+            self._error(e)
+
+    def do_POST(self):
+        parsed = urllib.parse.urlparse(self.path)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except (TypeError, ValueError):
+            length = 0
+        blob = self.rfile.read(length)
+        try:
+            if parsed.path == "/upload":
+                self._reply(self.server.owner.upload(
+                    blob, token=query.get("token")))
+            else:
+                self._reply({"error": "not found"}, code=404)
+        except Exception as e:
+            self._error(e)
+
+
+def main(argv=None):
+    import argparse
+    parser = argparse.ArgumentParser(description="veles_tpu forge server")
+    parser.add_argument("-r", "--root", required=True,
+                        help="storage directory")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("-p", "--port", type=int, default=8080)
+    parser.add_argument("--token", default=None,
+                        help="shared secret required for upload/delete")
+    args = parser.parse_args(argv)
+    server = ForgeServer(args.root, host=args.host, port=args.port,
+                         token=args.token)
+    server.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -79,7 +79,8 @@ class Jinja2TemplateBackend(Backend):
             with open(template_file) as fin:
                 self.template_text = fin.read()
         self.file = kwargs.get("file")
-        if self.file is None and self.requires_file:
+        if self.file is None and self.requires_file \
+                and not self._alternate_output(kwargs):
             # a misspelled kwarg must not silently render to nowhere
             raise ValueError("%s needs a file=... path (got kwargs %s)"
                              % (type(self).__name__, sorted(kwargs)))
@@ -89,6 +90,11 @@ class Jinja2TemplateBackend(Backend):
     @property
     def image_formats(self):
         return (self.image_format,)
+
+    @staticmethod
+    def _alternate_output(kwargs):
+        """Subclasses with other output channels override this."""
+        return False
 
     def render_content(self, info):
         import jinja2

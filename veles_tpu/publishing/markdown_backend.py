@@ -25,6 +25,11 @@ class MarkdownBackend(Jinja2TemplateBackend):
         self.html = kwargs.get("html", False)
         self.html_file = kwargs.get("html_file")
 
+    @staticmethod
+    def _alternate_output(kwargs):
+        # html_file-only configuration is a valid output target
+        return bool(kwargs.get("html_file"))
+
     def render(self, info):
         content = super(MarkdownBackend, self).render(info)
         if self.html or self.html_file:
