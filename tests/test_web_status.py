@@ -106,6 +106,16 @@ def test_html_pages(server):
     assert status == 200 and "veles_tpu workflows" in page
     status, page = _get(server.address, "/logs.html")
     assert status == 200 and "logs" in page
+    status, page = _get(server.address, "/frontend.html")
+    assert status == 200 and "command composer" in page
+
+
+def test_catalog_endpoint(server):
+    status, body = _get(server.address, "/catalog")
+    assert status == 200
+    catalog = json.loads(body)
+    assert "RESTfulAPI" in catalog["units"]
+    assert any("--test" in arg["flags"] for arg in catalog["arguments"])
 
 
 def test_log_handler_forwards_records(server):

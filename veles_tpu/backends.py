@@ -128,6 +128,30 @@ class Device(Logger, metaclass=BackendRegistry):
         return "<%s backend=%s>" % (type(self).__name__, self.BACKEND)
 
 
+def _enable_persistent_compile_cache():
+    """Point XLA's persistent compilation cache at the veles cache dir
+    (the role of the reference's on-disk kernel binary cache,
+    ``veles/accelerated_units.py:605-673``): first compile of a big
+    model costs minutes, every later process pays ~nothing."""
+    import jax
+    if jax.config.jax_compilation_cache_dir:
+        return  # user/installation already configured one
+    import os
+    cache_dir = os.path.join(root.common.dirs.get("cache", "."), "xla")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # also persist XLA-internal (autotune) caches where supported
+        try:
+            jax.config.update("jax_persistent_cache_enable_xla_caches",
+                              "all")
+        except Exception:
+            pass
+    except Exception:  # cache is an optimization, never a failure
+        pass
+
+
 class JaxDevice(Device):
     """Common behavior for JAX-backed devices (TPU and CPU)."""
 
@@ -137,6 +161,7 @@ class JaxDevice(Device):
         super(JaxDevice, self).__init__(**kwargs)
         import jax
         self._jax_ = jax
+        _enable_persistent_compile_cache()
         devices = [d for d in jax.devices()
                    if self.PLATFORM in (None, d.platform)]
         if not devices:

@@ -42,18 +42,22 @@ class DataParallelTrainer(FusedTrainer):
     def _compile_train(self, fn):
         repl = named_sharding(self.mesh)
         params_spec = self._params_spec()
+        # dataset/truth are replicated args (each chip gathers its own
+        # shard of every minibatch by index)
+        data_spec = (repl, repl)
         # idx_matrix: (n_batches, mb) — shard the per-step batch dim
         idx_spec = named_sharding(self.mesh, None, self.axis)
         return jax.jit(
             fn,
-            in_shardings=(params_spec, repl, idx_spec, repl),
+            in_shardings=(data_spec, params_spec, repl, idx_spec, repl),
             out_shardings=(params_spec, repl, repl, repl),
-            donate_argnums=(0, 1) if self.donate else ())
+            donate_argnums=(1, 2) if self.donate else ())
 
     def _compile_eval(self, fn):
         repl = named_sharding(self.mesh)
         idx_spec = named_sharding(self.mesh, None, self.axis)
-        return jax.jit(fn, in_shardings=(self._params_spec(), idx_spec),
+        return jax.jit(fn, in_shardings=((repl, repl),
+                                         self._params_spec(), idx_spec),
                        out_shardings=(repl, repl))
 
     def pull_params(self):

@@ -49,9 +49,17 @@ def describe_arguments():
     for action in parser._actions:
         if isinstance(action, argparse._HelpAction):
             continue
+        if isinstance(action, (argparse._StoreTrueAction,
+                               argparse._StoreFalseAction)):
+            kind = "flag"
+        elif not action.option_strings:
+            kind = "positional"
+        else:
+            kind = "option"
         args.append({
             "flags": list(action.option_strings) or [action.dest],
             "dest": action.dest,
+            "kind": kind,
             "default": action.default
             if not callable(action.default) else None,
             "choices": list(action.choices) if action.choices else None,

@@ -16,9 +16,12 @@ Differences from the reference, deliberate on TPU:
 * device buffers are never pickled — the restored workflow re-attaches
   to whatever device ``initialize(device=...)`` receives (a snapshot
   taken on TPU restores onto CPU and vice versa);
-* no ODBC target — file targets with gz/bz2/xz compression and a
-  ``_current`` symlink cover the reference's file path; a snapshot is a
-  single self-describing pickle stream with a small header dict.
+* the reference's ODBC target is realized as
+  :class:`SnapshotterToDB` over stdlib sqlite3 (no ODBC driver ships
+  here); restore accepts plain paths, ``http(s)://`` and
+  ``sqlite://db#key`` URIs. File targets keep gz/bz2/xz compression
+  and a ``_current`` symlink; a snapshot is a single self-describing
+  pickle stream with a small header dict.
 """
 
 import bz2
@@ -47,6 +50,31 @@ CODECS = {
 #: magic bytes -> opener (robust against misleading file names)
 MAGIC = ((b"\x1f\x8b", gzip.open), (b"BZh", bz2.open),
          (b"\xfd7zXZ\x00", lzma.open))
+
+
+def _maybe_decompress(payload):
+    """Inverse of :func:`_compress` for in-memory payloads, sniffing
+    the codec from magic bytes (shared by the http/sqlite restores)."""
+    import io
+    for magic, opener in MAGIC:
+        if payload.startswith(magic):
+            with opener(io.BytesIO(payload), "rb") as fin:
+                return fin.read()
+    return payload
+
+
+def _compress(payload, compression):
+    """Compress a snapshot payload in memory; validates the codec."""
+    import io
+    if compression not in CODECS:
+        raise ValueError("unknown compression %r (have %s)" %
+                         (compression, sorted(k for k in CODECS if k)))
+    if not compression:
+        return payload
+    buf = io.BytesIO()
+    with CODECS[compression](buf, "wb") as fout:
+        fout.write(payload)
+    return buf.getvalue()
 
 
 def _open_for_read(path):
@@ -181,10 +209,84 @@ class SnapshotterToFile(SnapshotterBase):
             self.debug("could not update %s: %s", link_path, exc)
 
     @staticmethod
-    def import_(path):
-        """Load a snapshot: returns the workflow, with the PRNG registry
-        restored so the random streams continue where they left off."""
-        return load_workflow(path)
+    def import_(uri):
+        """Load a snapshot from a file path or URI.
+
+        The reference accepted file/http/odbc URIs for ``--snapshot``
+        (``veles/__main__.py:539-589``); here: plain paths,
+        ``http(s)://`` (fetched to memory) and ``sqlite://<db>#<key>``
+        (the :class:`SnapshotterToDB` store). Returns the workflow with
+        the PRNG registry restored so random streams continue where
+        they left off."""
+        if isinstance(uri, str) and uri.startswith(("http://",
+                                                    "https://")):
+            import urllib.request
+            with urllib.request.urlopen(uri, timeout=60) as resp:
+                payload = resp.read()
+            return load_workflow(_maybe_decompress(payload))
+        if isinstance(uri, str) and uri.startswith("sqlite://"):
+            return SnapshotterToDB.import_(uri)
+        return load_workflow(uri)
+
+
+class SnapshotterToDB(SnapshotterBase):
+    """Snapshot into a SQL database (the reference's ODBC target,
+    ``veles/snapshotter.py:427-518``, realized over stdlib sqlite3 —
+    no ODBC driver ships in this environment).
+
+    URI form for restore: ``sqlite:///path/to/file.db#<key>`` where
+    ``<key>`` defaults to the newest row.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        self.database = kwargs.pop("database", None)
+        super(SnapshotterToDB, self).__init__(workflow, **kwargs)
+        if not self.database:
+            raise ValueError("SnapshotterToDB needs database=path.db")
+
+    @staticmethod
+    def _ensure_schema(conn):
+        conn.execute(
+            "CREATE TABLE IF NOT EXISTS snapshots ("
+            " key TEXT PRIMARY KEY, checksum TEXT, epoch INTEGER,"
+            " created REAL, payload BLOB)")
+
+    def export(self):
+        import sqlite3
+        wf = self.workflow
+        payload = _compress(dump_workflow(wf), self.compression)
+        epoch = SnapshotterToFile._wf_epoch(wf)
+        key = "%s_%s.%d" % (self.prefix, self.suffix or "snap", epoch)
+        with sqlite3.connect(self.database) as conn:
+            self._ensure_schema(conn)
+            conn.execute(
+                "INSERT OR REPLACE INTO snapshots VALUES (?, ?, ?, ?, ?)",
+                (key, wf.checksum, epoch, time.time(),
+                 sqlite3.Binary(payload)))
+        self.destination = "sqlite://%s#%s" % (self.database, key)
+        self.info("snapshotted to %s (%.1f MiB)", self.destination,
+                  len(payload) / 1048576.0)
+
+    @staticmethod
+    def import_(uri):
+        import sqlite3
+        spec = uri[len("sqlite://"):]
+        database, _, key = spec.partition("#")
+        if not os.path.exists(database):
+            # a restore must not create an empty DB on a typo'd path
+            raise FileNotFoundError("no snapshot database: %s" % database)
+        with sqlite3.connect(database) as conn:
+            if key:
+                row = conn.execute(
+                    "SELECT payload FROM snapshots WHERE key = ?",
+                    (key,)).fetchone()
+            else:
+                row = conn.execute(
+                    "SELECT payload FROM snapshots "
+                    "ORDER BY created DESC LIMIT 1").fetchone()
+        if row is None:
+            raise KeyError("no snapshot %r in %s" % (key, database))
+        return load_workflow(_maybe_decompress(bytes(row[0])))
 
 
 def dump_workflow(workflow):

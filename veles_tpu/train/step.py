@@ -123,23 +123,31 @@ class FusedTrainer(Logger):
         self.hypers = hypers
 
         # resolve the dataset's device arrays OUTSIDE any trace: calling
-        # .devmem under jit would cache a tracer inside the Array
-        dataset = self.loader.original_data.devmem
-        truth_src = (self.loader.original_labels.devmem
-                     if self.loss_kind == "softmax"
-                     else self.loader.original_targets.devmem)
+        # .devmem under jit would cache a tracer inside the Array.
+        # CRITICAL: they are passed to the compiled functions as
+        # ARGUMENTS, never closed over — a closure-captured array is
+        # baked into the HLO as a constant, which (a) bloats the
+        # program by the whole dataset (hundreds of MB for ImageNet
+        # shapes — enough to kill remote-compile services) and (b)
+        # defeats donation/sharding of the dataset buffer.
+        self._data_args = (
+            self.loader.original_data.devmem,
+            self.loader.original_labels.devmem
+            if self.loss_kind == "softmax"
+            else self.loader.original_targets.devmem)
 
-        def gather(idx):
+        def gather(data_args, idx):
+            dataset, truth_src = data_args
             data = jnp.take(dataset, jnp.maximum(idx, 0), axis=0)
             data = data * (idx >= 0).reshape(
                 (-1,) + (1,) * (data.ndim - 1)).astype(data.dtype)
             truth = jnp.take(truth_src, jnp.maximum(idx, 0), axis=0)
             return data, truth
 
-        def train_batch(carry, batch_in):
+        def train_batch(data_args, carry, batch_in):
             params_list, opt_states = carry
             idx, key = batch_in
-            x, truth = gather(idx)
+            x, truth = gather(data_args, idx)
             valid = idx >= 0
 
             def loss_fn(plist):
@@ -163,16 +171,25 @@ class FusedTrainer(Logger):
                 new_states.append(s)
             return (tuple(new_params), tuple(new_states)), (loss, metric)
 
-        def train_segment(params_list, opt_states, idx_matrix, keys):
+        def train_segment(data_args, params_list, opt_states, idx_matrix,
+                          keys):
             (params_list, opt_states), (losses, metrics) = jax.lax.scan(
-                train_batch, (params_list, opt_states), (idx_matrix, keys))
+                lambda carry, batch_in: train_batch(data_args, carry,
+                                                    batch_in),
+                (params_list, opt_states), (idx_matrix, keys))
             return params_list, opt_states, losses, metrics
 
-        self._train_segment = self._compile_train(train_segment)
+        jit_train = self._compile_train(train_segment)
 
-        def eval_segment_pure(params_list, idx_matrix):
+        def _train_segment_call(params_list, opt_states, idx_matrix, keys):
+            return jit_train(self._data_args, params_list, opt_states,
+                             idx_matrix, keys)
+
+        self._train_segment = _train_segment_call
+
+        def eval_segment_pure(data_args, params_list, idx_matrix):
             def body(_, idx):
-                x, truth = gather(idx)
+                x, truth = gather(data_args, idx)
                 valid = idx >= 0
                 out = self._forward(params_list, x, None, train=False)
                 _, report, metric = self._loss_and_metrics(out, truth,
@@ -181,12 +198,19 @@ class FusedTrainer(Logger):
             _, (losses, metrics) = jax.lax.scan(body, None, idx_matrix)
             return losses, metrics
 
-        self._eval_segment = self._compile_eval(eval_segment_pure)
+        jit_eval = self._compile_eval(eval_segment_pure)
+
+        def _eval_segment_call(params_list, idx_matrix):
+            return jit_eval(self._data_args, params_list, idx_matrix)
+
+        self._eval_segment = _eval_segment_call
 
     # -- compilation hooks (overridden by parallel trainers) ---------------
+    # signatures: train fn(data_args, params, states, idx, keys),
+    #             eval fn(data_args, params, idx)
 
     def _compile_train(self, fn):
-        return jax.jit(fn, donate_argnums=(0, 1) if self.donate else ())
+        return jax.jit(fn, donate_argnums=(1, 2) if self.donate else ())
 
     def _compile_eval(self, fn):
         return jax.jit(fn)

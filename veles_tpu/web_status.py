@@ -106,6 +106,89 @@ refresh(); setInterval(refresh, 3000);
 </script></body></html>"""
 
 
+_FRONTEND_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu frontend</title><style>
+body { font-family: sans-serif; margin: 2em; background: #fafafa;
+       max-width: 70em; }
+code, #cmd { font-family: monospace; background: #eee; padding: 0.5em;
+             display: block; margin: 1em 0; white-space: pre-wrap; }
+.arg { margin: 0.25em 0; } .arg input { margin-left: 0.5em; }
+h2 { margin-top: 1.5em; } .doc { color: #666; font-size: 0.9em; }
+table { border-collapse: collapse; } td, th { border: 1px solid #ccc;
+padding: 0.3em 0.6em; text-align: left; }
+</style></head><body>
+<h1>veles_tpu command composer</h1>
+<p>Build a <code style="display:inline">python -m veles_tpu</code>
+command line from the registered options; the unit catalog below is
+what <code style="display:inline">generate_frontend</code> exports.</p>
+<div class="arg">workflow file: <input id="wf" size="40"
+     value="workflow.py"></div>
+<div class="arg">config file: <input id="cfg" size="40"></div>
+<div id="args"></div>
+<code id="cmd"></code>
+<h2>Registered units</h2>
+<table id="units"><thead><tr><th>unit</th><th>module</th><th>group</th>
+<th>doc</th></tr></thead><tbody></tbody></table>
+<script>
+let catalog = {arguments: [], units: {}};
+function rebuild() {
+  let cmd = "python -m veles_tpu";
+  for (const arg of catalog.arguments) {
+    if (arg.kind === "positional") continue;  // wf/cfg inputs cover these
+    const el = document.getElementById("arg-" + arg.dest);
+    if (!el) continue;
+    if (arg.kind === "flag") {
+      if (el.checked) cmd += " " + arg.flags[0];
+      continue;
+    }
+    if (!el.value || el.value === String(arg.default)) continue;
+    cmd += " " + arg.flags[0] + " " + el.value;
+  }
+  cmd += " " + document.getElementById("wf").value;
+  const cfg = document.getElementById("cfg").value;
+  if (cfg) cmd += " " + cfg;
+  document.getElementById("cmd").textContent = cmd;
+}
+async function load() {
+  const resp = await fetch("/catalog");
+  catalog = await resp.json();
+  const argsDiv = document.getElementById("args");
+  for (const arg of catalog.arguments) {
+    if (arg.kind === "positional") continue;
+    const div = document.createElement("div");
+    div.className = "arg";
+    const label = document.createElement("label");
+    label.textContent = arg.flags.join(", ");
+    label.title = arg.help;
+    const input = document.createElement("input");
+    input.id = "arg-" + arg.dest;
+    if (arg.kind === "flag") {
+      input.type = "checkbox";
+    } else {
+      input.placeholder = arg.default === null ? "" : String(arg.default);
+    }
+    input.addEventListener("input", rebuild);
+    div.appendChild(label); div.appendChild(input);
+    argsDiv.appendChild(div);
+  }
+  const tbody = document.querySelector("#units tbody");
+  for (const [name, unit] of Object.entries(catalog.units)) {
+    const tr = document.createElement("tr");
+    for (const v of [name, unit.module, unit.view_group, unit.doc]) {
+      const td = document.createElement("td");
+      td.textContent = v || "";
+      tr.appendChild(td);
+    }
+    tbody.appendChild(tr);
+  }
+  document.getElementById("wf").addEventListener("input", rebuild);
+  document.getElementById("cfg").addEventListener("input", rebuild);
+  rebuild();
+}
+load();
+</script></body></html>"""
+
+
 def _match(record, query):
     """MongoDB-lite ``find``: top-level equality (+ $in / $gte / $lte)."""
     for key, cond in query.items():
@@ -157,6 +240,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(_STATUS_PAGE, ctype="text/html; charset=utf-8")
         elif self.path.startswith("/logs.html"):
             self._reply(_LOGS_PAGE, ctype="text/html; charset=utf-8")
+        elif self.path.startswith("/frontend.html"):
+            self._reply(_FRONTEND_PAGE, ctype="text/html; charset=utf-8")
+        elif self.path.startswith("/catalog"):
+            try:
+                body = json.dumps(self.server.owner.catalog(),
+                                  default=str)
+            except Exception as e:
+                self._reply({"error": str(e) or type(e).__name__},
+                            code=500)
+            else:
+                self._reply(body.encode("utf-8"))
         else:
             self._reply({"error": "not found"}, code=404)
 
@@ -205,6 +299,14 @@ class WebStatusServer(Logger):
     @property
     def port(self):
         return self.address[1]
+
+    def catalog(self):
+        """Unit/argument catalog for the composer page (lazy, cached)."""
+        with self._lock:
+            if not hasattr(self, "_catalog"):
+                from veles_tpu.scripts.generate_frontend import generate
+                self._catalog = generate()
+            return self._catalog
 
     # -- receiving ---------------------------------------------------------
 
