@@ -1,0 +1,17 @@
+#!/bin/sh
+# Minimal bootstrap (the reference's deploy/ubuntu.sh role): install the
+# package + services on a Debian-ish host. Run from the repo root.
+set -e
+
+PYTHON=${PYTHON:-python3}
+
+$PYTHON -m pip install .
+$PYTHON -c "from veles_tpu.export.native import build_native; build_native()"
+
+if [ -d /etc/systemd/system ] && [ "$(id -u)" = 0 ]; then
+    install -m 644 deploy/systemd/veles-tpu-forge.service \
+        deploy/systemd/veles-tpu-web-status.service /etc/systemd/system/
+    systemctl daemon-reload
+    echo "enable with: systemctl enable --now veles-tpu-forge veles-tpu-web-status"
+fi
+echo "done."

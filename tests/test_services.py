@@ -2,6 +2,7 @@
 
 import io
 import json
+import os
 import subprocess
 import sys
 import wave
@@ -72,6 +73,41 @@ def test_debug_deadlocks_flags_non_daemon_thread():
         gate.set()
         thr.join()
     assert debug_deadlocks(file=io.StringIO()) == []
+
+
+def test_manhole_eval_and_exec(tmp_path):
+    import socket
+    from veles_tpu.interaction import Manhole
+    wf = DummyWorkflow()
+    manhole = Manhole(path=str(tmp_path / "mh.sock"),
+                      locals={"workflow": wf, "x": 41}).start()
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+            sock.connect(manhole.path)
+            f = sock.makefile("rw")
+            assert "manhole" in f.readline()
+            banner = f.read(4)  # ">>> "
+            f.write("x + 1\n")
+            f.flush()
+            assert f.readline().strip() == "42"
+            f.read(4)
+            f.write("y = x * 2\n")  # exec path (statement)
+            f.flush()
+            f.read(4)
+            f.write("y\n")
+            f.flush()
+            assert f.readline().strip() == "82"
+            f.read(4)
+            f.write("1/0\n")  # errors answered, connection survives
+            f.flush()
+            assert "ZeroDivisionError" in f.readline()
+            f.read(4)
+            f.write("workflow.name\n")
+            f.flush()
+            assert "Dummy" in f.readline()
+    finally:
+        manhole.stop()
+    assert not os.path.exists(manhole.path)
 
 
 # -- scripts ---------------------------------------------------------------

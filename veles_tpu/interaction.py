@@ -80,6 +80,94 @@ class Shell(Unit, TriviallyDistributable):
             self.interact()
 
 
+class Manhole(object):
+    """UNIX-socket debug REPL (the reference's bundled manhole,
+    ``veles/external/manhole.py`` + ``thread_pool.py:527-533``).
+
+    ``Manhole(locals={"workflow": wf}).start()`` listens on an abstract
+    unix socket; connect with ``socket`` + a line-based client (or
+    ``nc -U``) and evaluate Python in the provided namespace. Each
+    line is evaluated (expression → repr sent back) or executed.
+    """
+
+    def __init__(self, path=None, locals=None):
+        self.path = path
+        self.locals = dict(locals or {})
+        self._listener = None
+        self._accepting = False
+        self._own_dir = None
+
+    def start(self):
+        import os
+        import socket
+        import tempfile
+        if self.path is None:
+            # a private 0700 directory: a world-writable /tmp path is
+            # both squat-able and, under a loose umask, connectable by
+            # other local users (this is an eval() endpoint)
+            self._own_dir = tempfile.mkdtemp(prefix="veles_tpu_manhole_")
+            self.path = os.path.join(self._own_dir, "manhole.sock")
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.path)
+        os.chmod(self.path, 0o600)
+        self._listener.listen(2)
+        self._accepting = True
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="Manhole").start()
+        return self
+
+    def _accept_loop(self):
+        listener = self._listener  # stop() may null the attribute
+        while self._accepting:
+            try:
+                sock, _ = listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(sock,),
+                             daemon=True, name="Manhole-client").start()
+
+    def _serve(self, sock):
+        f = sock.makefile("rw")
+        with sock:
+            f.write("veles_tpu manhole (%s)\n>>> " %
+                    ", ".join(sorted(self.locals)) )
+            f.flush()
+            for line in f:
+                line = line.rstrip("\n")
+                if line in ("exit", "quit", "exit()", "quit()"):
+                    return
+                try:
+                    try:
+                        result = eval(line, self.locals)  # noqa: S307
+                        if result is not None:
+                            f.write(repr(result) + "\n")
+                    except SyntaxError:
+                        exec(line, self.locals)  # noqa: S102
+                except SystemExit:
+                    return
+                except BaseException as e:
+                    f.write("%s: %s\n" % (type(e).__name__, e))
+                f.write(">>> ")
+                f.flush()
+
+    def stop(self):
+        import os
+        import shutil
+        self._accepting = False
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            finally:
+                self._listener = None
+        if self.path and os.path.exists(self.path):
+            os.unlink(self.path)
+        if self._own_dir is not None:
+            shutil.rmtree(self._own_dir, ignore_errors=True)
+            self._own_dir = None
+
+
 def print_thread_stacks(file=None):
     """Dump every live thread's stack (``thread_pool.py:536-546``)."""
     file = file or sys.stderr

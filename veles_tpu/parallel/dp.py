@@ -33,6 +33,13 @@ class DataParallelTrainer(FusedTrainer):
         self.axis = axis
         self._param_shardings = param_shardings
         super(DataParallelTrainer, self).__init__(workflow, **kwargs)
+        # the loader uploaded the dataset committed to ONE device
+        # (memory.py device_put); replicate it onto the mesh to match
+        # the declared in_shardings — same clash pull_params() resolves
+        # for the parameters
+        repl = named_sharding(self.mesh)
+        self._data_args = tuple(jax.device_put(a, repl)
+                                for a in self._data_args)
 
     def _params_spec(self):
         if self._param_shardings is not None:

@@ -66,11 +66,11 @@ def _maybe_decompress(payload):
 def _compress(payload, compression):
     """Compress a snapshot payload in memory; validates the codec."""
     import io
+    if not compression:
+        return payload  # "" / None = uncompressed, always valid
     if compression not in CODECS:
         raise ValueError("unknown compression %r (have %s)" %
                          (compression, sorted(k for k in CODECS if k)))
-    if not compression:
-        return payload
     buf = io.BytesIO()
     with CODECS[compression](buf, "wb") as fout:
         fout.write(payload)

@@ -287,6 +287,8 @@ class WebStatusServer(Logger):
         self.logs = collections.deque(maxlen=max_records)
         self.events = collections.deque(maxlen=max_records)
         self._lock = threading.Lock()
+        self._catalog = None
+        self._catalog_lock = threading.Lock()
         self._server = ThreadingHTTPServer(
             (host if host is not None else root.common.web.host,
              port if port is not None else root.common.web.port),
@@ -301,9 +303,13 @@ class WebStatusServer(Logger):
         return self.address[1]
 
     def catalog(self):
-        """Unit/argument catalog for the composer page (lazy, cached)."""
-        with self._lock:
-            if not hasattr(self, "_catalog"):
+        """Unit/argument catalog for the composer page (lazy, cached).
+
+        Uses its own lock: generate() imports the whole unit registry
+        (seconds), and self._lock also serializes receive_update from
+        live masters — the first page load must not stall them."""
+        with self._catalog_lock:
+            if self._catalog is None:
                 from veles_tpu.scripts.generate_frontend import generate
                 self._catalog = generate()
             return self._catalog
