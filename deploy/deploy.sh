@@ -9,6 +9,8 @@ $PYTHON -m pip install .
 $PYTHON -c "from veles_tpu.export.native import build_native; build_native()"
 
 if [ -d /etc/systemd/system ] && [ "$(id -u)" = 0 ]; then
+    id veles >/dev/null 2>&1 || useradd -r -s /usr/sbin/nologin veles
+    install -d -o veles -g veles /var/lib/veles-tpu/forge
     install -m 644 deploy/systemd/veles-tpu-forge.service \
         deploy/systemd/veles-tpu-web-status.service /etc/systemd/system/
     systemctl daemon-reload

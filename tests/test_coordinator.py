@@ -85,3 +85,40 @@ def test_slave_registry_and_power():
         assert slave.id == client.id
     finally:
         server.stop()
+
+
+def test_sharedio_fast_path_same_host():
+    """Same machine id → big blobs ride shared memory, only refs cross
+    the socket (the reference's SharedIO, txzmq/sharedio.py:44-106)."""
+    big = "x" * (256 * 1024)
+    server = CoordinatorServer(checksum="c")
+    try:
+        server.submit({"blob": big}, {"blob": big + big})  # regrow path
+        client = CoordinatorClient(server.address, checksum="c").connect()
+        # in-process ⇒ machine ids match ⇒ both senders enabled
+        assert client.proto._shm_tx
+        client.serve_forever(
+            lambda job: {"blob": job["blob"] + "y"},  # big update back
+            max_idle=3)
+        results = server.wait(2, timeout=5)
+        assert sorted(len(r["blob"]) for r in results) == \
+            [256 * 1024 + 1, 512 * 1024 + 1]
+        assert all(r["blob"].endswith("xy") for r in results)
+        assert client.proto.shm_reads >= 1     # jobs restored from shm
+        assert client.proto.shm_sends >= 1     # updates offloaded
+    finally:
+        server.stop()
+
+
+def test_sharedio_small_blobs_stay_inline():
+    server = CoordinatorServer(checksum="c")
+    try:
+        server.submit({"blob": "tiny"})
+        client = CoordinatorClient(server.address, checksum="c").connect()
+        client.serve_forever(lambda job: {"blob": job["blob"]},
+                             max_idle=3)
+        assert server.wait(1, timeout=5) == [{"blob": "tiny"}]
+        assert client.proto.shm_sends == 0
+        assert client.proto.shm_reads == 0
+    finally:
+        server.stop()

@@ -36,24 +36,126 @@ class NoMoreJobsError(Exception):
 
 
 class Protocol(object):
-    """JSON-lines framing over a socket."""
+    """JSON-lines framing over a socket, with an optional same-host
+    shared-memory fast path.
+
+    When both peers share a machine (``enable_sharedio()`` after the
+    handshake's machine-id comparison), large ``"blob"`` payloads go
+    through ONE sender-owned ``multiprocessing.shared_memory`` segment
+    — the socket carries only ``{"__shm__": name, "size": n}``. The
+    segment is reused across messages and regrown on demand: the
+    re-design of the reference's ``txzmq/sharedio.py:44-106`` + the
+    IOOverflow regrow (``server.py:156-167``). Safe because the
+    protocol is strict request↔reply per connection, so a segment is
+    never written while the peer still reads it.
+    """
+
+    #: blobs below this stay inline (shm setup isn't free)
+    SHM_THRESHOLD = 64 * 1024
 
     def __init__(self, sock):
         self.sock = sock
         self._file = sock.makefile("rwb")
         self._wlock = threading.Lock()
+        self._shm_tx = False
+        self._segment = None
+        self.shm_sends = 0
+        self.shm_reads = 0
+
+    # -- sharedio ----------------------------------------------------------
+
+    def enable_sharedio(self):
+        """Sender-side opt-in (receive always understands the refs)."""
+        self._shm_tx = True
+
+    def _segment_for(self, size):
+        from multiprocessing import shared_memory
+        if self._segment is not None and self._segment.size >= size:
+            return self._segment
+        if self._segment is not None:  # regrow
+            self._segment.close()
+            self._segment.unlink()
+        self._segment = shared_memory.SharedMemory(
+            create=True, size=max(size, self.SHM_THRESHOLD))
+        return self._segment
+
+    def _offload(self, message):
+        if not isinstance(message, dict):
+            return message
+        out = {}
+        for key, value in message.items():
+            if key == "blob" and isinstance(value, str) \
+                    and len(value) >= self.SHM_THRESHOLD:
+                data = value.encode("utf-8")  # blobs may be any text
+                seg = self._segment_for(len(data))
+                seg.buf[:len(data)] = data
+                self.shm_sends += 1
+                out[key] = {"__shm__": seg.name, "size": len(data)}
+            elif isinstance(value, dict):
+                out[key] = self._offload(value)
+            else:
+                out[key] = value
+        return out
+
+    @classmethod
+    def _restore(cls, message):
+        if not isinstance(message, dict):
+            return message
+        out = {}
+        for key, value in message.items():
+            if isinstance(value, dict) and "__shm__" in value:
+                from multiprocessing import shared_memory
+                seg = shared_memory.SharedMemory(name=value["__shm__"])
+                try:
+                    # CPython's SharedMemory registers every attach with
+                    # THIS process's resource tracker, which would
+                    # unlink the sender's live segment when we exit —
+                    # deregister: the sender owns the segment
+                    from multiprocessing import resource_tracker
+                    resource_tracker.unregister(seg._name, "shared_memory")
+                except Exception:
+                    pass
+                try:
+                    out[key] = bytes(seg.buf[:value["size"]]
+                                     ).decode("utf-8")
+                finally:
+                    seg.close()  # sender owns the segment; never unlink
+            elif isinstance(value, dict):
+                out[key] = cls._restore(value)
+            else:
+                out[key] = value
+        return out
+
+    # -- framing -----------------------------------------------------------
 
     def send(self, message):
-        data = (json.dumps(message) + "\n").encode()
+        # offload under the write lock: the shared segment must not be
+        # overwritten while a previous ref is still in flight
         with self._wlock:
-            self._file.write(data)
+            if self._shm_tx:
+                message = self._offload(message)
+            self._file.write((json.dumps(message) + "\n").encode())
             self._file.flush()
 
     def recv(self):
         line = self._file.readline()
         if not line:
             raise ConnectionError("peer closed")
-        return json.loads(line)
+        message = json.loads(line)
+        if self._has_shm_ref(message):
+            self.shm_reads += 1
+            return self._restore(message)
+        return message
+
+    @classmethod
+    def _has_shm_ref(cls, message):
+        if not isinstance(message, dict):
+            return False
+        for value in message.values():
+            if isinstance(value, dict):
+                if "__shm__" in value or cls._has_shm_ref(value):
+                    return True
+        return False
 
     def close(self):
         try:
@@ -61,6 +163,13 @@ class Protocol(object):
             self.sock.close()
         except OSError:
             pass
+        if self._segment is not None:
+            try:
+                self._segment.close()
+                self._segment.unlink()
+            except (OSError, FileNotFoundError):
+                pass
+            self._segment = None
 
 
 class SlaveDescription(object):
@@ -229,7 +338,13 @@ class CoordinatorServer(Logger):
                     sid, hello.get("power", 1.0), hello.get("mid"),
                     hello.get("pid"))
                 slave_desc = self.slaves[sid]
-            reply = {"id": sid, "log_id": sid}
+            # same machine → job/update blobs ride shared memory, only
+            # the refs cross the socket (endpoint-by-locality, the
+            # reference's server.py:721-732 inproc/ipc/tcp choice)
+            if hello.get("mid") == hex(uuid.getnode()):
+                proto.enable_sharedio()
+            reply = {"id": sid, "log_id": sid,
+                     "mid": hex(uuid.getnode())}
             if self.initial_data_source is not None:
                 reply["data"] = self.initial_data_source(slave_desc)
             proto.send(reply)
@@ -380,6 +495,9 @@ class CoordinatorClient(Logger):
             raise ConnectionError(reply["error"])
         self.id = reply["id"]
         self.initial_data = reply.get("data")
+        if reply.get("mid") == hex(uuid.getnode()):
+            # same machine as the master: updates ride shared memory
+            self.proto.enable_sharedio()
         # dedicated heartbeat channel so long handler() runs don't get
         # this slave declared dead mid-job
         hb_sock = socket.create_connection(self.address, timeout=10.0)
