@@ -122,3 +122,40 @@ def test_sharedio_small_blobs_stay_inline():
         assert client.proto.shm_reads == 0
     finally:
         server.stop()
+
+
+def test_sharedio_multiple_blobs_one_message():
+    """Two big blobs in ONE message must not overwrite each other in
+    the shared segment (offset-packed refs)."""
+    from veles_tpu.parallel.coordinator import Protocol
+    import socket as socket_mod
+    a, b = socket_mod.socketpair()
+    tx, rx = Protocol(a), Protocol(b)
+    tx.enable_sharedio()
+    rx.enable_sharedio()
+    big_a = "A" * (100 * 1024)
+    big_b = "B" * (150 * 1024) + "é"   # non-ascii tail
+    try:
+        tx.send({"one": {"blob": big_a}, "two": {"blob": big_b}})
+        msg = rx.recv()
+        assert msg["one"]["blob"] == big_a
+        assert msg["two"]["blob"] == big_b
+        assert tx.shm_sends == 2
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_shm_refs_from_untrusted_peer_stay_inert():
+    from veles_tpu.parallel.coordinator import Protocol
+    import socket as socket_mod
+    a, b = socket_mod.socketpair()
+    tx, rx = Protocol(a), Protocol(b)  # sharedio NEVER enabled on rx
+    try:
+        tx.send({"payload": {"__shm__": "psm_evil", "size": 4}})
+        msg = rx.recv()
+        # delivered as plain data, no attach attempt
+        assert msg["payload"] == {"__shm__": "psm_evil", "size": 4}
+    finally:
+        tx.close()
+        rx.close()

@@ -1,0 +1,95 @@
+"""Remote slave spawning (reference launcher.py:808-842, --respawn)."""
+
+import os
+import stat
+import sys
+import time
+
+import pytest
+
+from veles_tpu.parallel.nodes import (NodeLauncher, parse_nodes,
+                                      slave_command_from_argv)
+
+
+def test_parse_nodes():
+    assert parse_nodes("a,b*3, c") == [("a", 1), ("b", 3), ("c", 1)]
+    assert parse_nodes("") == []
+
+
+def test_slave_command_from_argv():
+    cmd = slave_command_from_argv(
+        ["workflow.py", "config.py", "-l", "0.0.0.0:5000", "--nodes",
+         "h1,h2", "--respawn", "--job-timeout", "30"],
+        ("master-host", 5000))
+    assert "-l" not in cmd.split() and "--nodes" not in cmd.split()
+    assert "--respawn" not in cmd
+    assert "-m master-host:5000" in cmd
+    assert "workflow.py" in cmd and "--job-timeout 30" in cmd
+    assert cmd.startswith(sys.executable)
+
+
+def test_localhost_spawn_and_stop(tmp_path):
+    marker = tmp_path / "ran_{index}"
+    launcher = NodeLauncher(
+        "localhost*3",
+        "touch %s && sleep 30" % (str(tmp_path / "ran_{index}")))
+    launcher.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(list(tmp_path.glob("ran_*"))) < 3:
+            time.sleep(0.1)
+        assert sorted(p.name for p in tmp_path.glob("ran_*")) == \
+            ["ran_0", "ran_1", "ran_2"]
+        assert launcher.alive == 3
+    finally:
+        launcher.stop()
+    assert launcher.alive == 0
+
+
+def test_ssh_command_construction(tmp_path):
+    """A fake ssh records its argv; remote hosts must go through it."""
+    log = tmp_path / "ssh.log"
+    fake_ssh = tmp_path / "fake_ssh"
+    fake_ssh.write_text("#!/bin/sh\necho \"$@\" >> %s\n" % log)
+    fake_ssh.chmod(fake_ssh.stat().st_mode | stat.S_IEXEC)
+    launcher = NodeLauncher(
+        "nodeA,nodeB*2", "run-slave --master {master} --idx {index}",
+        master_address=("10.0.0.1", 5000),
+        ssh_binary=str(fake_ssh))
+    launcher.start()
+    assert launcher.wait(timeout=10)
+    lines = log.read_text().strip().split("\n")
+    assert len(lines) == 3
+    hosts = sorted(line.split()[0] for line in lines)
+    assert hosts == ["nodeA", "nodeB", "nodeB"]
+    assert all("--master 10.0.0.1:5000" in line for line in lines)
+    indices = sorted(line.split("--idx ")[1] for line in lines)
+    assert indices == ["0", "1", "2"]
+
+
+def test_respawn_with_backoff(tmp_path):
+    counter = tmp_path / "count"
+    # each run appends a line then dies -> must be respawned
+    launcher = NodeLauncher(
+        "localhost", "echo run >> %s" % counter,
+        respawn=True, max_respawns=2)
+    launcher.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if counter.exists() and \
+                    len(counter.read_text().splitlines()) >= 3:
+                break
+            time.sleep(0.1)
+        # initial + 2 respawns, then gives up
+        assert len(counter.read_text().splitlines()) == 3
+    finally:
+        launcher.stop()
+
+
+def test_launcher_accepts_nodes_kwargs():
+    from veles_tpu.launcher import Launcher
+    launcher = Launcher(listen_address="127.0.0.1:0", nodes="localhost",
+                        respawn=True, slave_command="true")
+    assert launcher.nodes == "localhost"
+    assert launcher.respawn

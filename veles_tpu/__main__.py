@@ -153,6 +153,8 @@ class Main(Logger):
             "job_timeout": args.job_timeout,
             "graphics": getattr(args, "graphics", True),
             "web_status": getattr(args, "web_status", False),
+            "nodes": getattr(args, "nodes", None),
+            "respawn": getattr(args, "respawn", False),
         }
         if args.listen_address:
             kwargs["listen_address"] = args.listen_address

@@ -59,6 +59,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         "listen_address", "master_address", "device", "backend", "testing",
         "stealth", "web_status", "graphics", "slave_death_probability",
         "job_timeout", "heartbeat_timeout", "max_idle",
+        "nodes", "respawn", "slave_command",
     ])
 
     def __init__(self, **kwargs):
@@ -82,6 +83,10 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.job_timeout = kwargs.get("job_timeout")
         self.heartbeat_timeout = kwargs.get("heartbeat_timeout", 10.0)
         self.max_idle = kwargs.get("max_idle")
+        self.nodes = kwargs.get("nodes")
+        self.respawn = kwargs.get("respawn", False)
+        self.slave_command = kwargs.get("slave_command")
+        self._node_launcher = None
         self.id = str(uuid.uuid4())
         self.log_id = self.id[:8]
         self.workflow = None
@@ -116,6 +121,13 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         parser.add_argument(
             "--no-graphics", dest="graphics", action="store_false",
             help="do not launch the plotting service")
+        parser.add_argument(
+            "-n", "--nodes", default=None,
+            help="master: spawn slaves on these hosts over SSH "
+                 "(host[,host*N,...])")
+        parser.add_argument(
+            "--respawn", action="store_true",
+            help="master: relaunch dead slaves with backoff")
         parser.add_argument(
             "--web-status", action="store_true",
             help="post periodic status JSON to the web dashboard")
@@ -233,6 +245,23 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             job_source=job_source, result_sink=result_sink,
             on_drop=on_drop, initial_data_source=initial_data_source)
         self.info("master listening on %s:%d", *self._server.address)
+        if self.nodes:
+            import socket as socket_mod
+            import sys
+            from veles_tpu.parallel.nodes import (NodeLauncher,
+                                                  slave_command_from_argv)
+            # remote slaves can't dial a wildcard/loopback listen
+            # address — advertise this host's name instead
+            # (``veles/launcher.py:820-822``)
+            host, port = self._server.address
+            if host in ("", "0.0.0.0", "::", "localhost", "127.0.0.1"):
+                host = socket_mod.gethostname()
+            advertise = (host, port)
+            command = self.slave_command or slave_command_from_argv(
+                sys.argv[1:], advertise)
+            self._node_launcher = NodeLauncher(
+                self.nodes, command, master_address=advertise,
+                respawn=self.respawn).start()
 
     def _connect_slave(self):
         from veles_tpu.parallel.coordinator import CoordinatorClient
@@ -330,6 +359,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self._finished.set()
         if self._client is not None:
             self._client.close()
+        if self._node_launcher is not None:
+            self._node_launcher.stop()
         if self._server is not None:
             self._server.stop()
         if self._graphics_server is not None:

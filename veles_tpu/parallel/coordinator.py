@@ -58,6 +58,7 @@ class Protocol(object):
         self._file = sock.makefile("rwb")
         self._wlock = threading.Lock()
         self._shm_tx = False
+        self._shm_rx = False
         self._segment = None
         self.shm_sends = 0
         self.shm_reads = 0
@@ -65,8 +66,14 @@ class Protocol(object):
     # -- sharedio ----------------------------------------------------------
 
     def enable_sharedio(self):
-        """Sender-side opt-in (receive always understands the refs)."""
+        """Opt in after the handshake's machine-id comparison. Both
+        directions: sending offloads blobs, and receiving will
+        dereference ``__shm__`` refs — a protocol that never enabled
+        sharedio (remote peer, feed sockets) treats such refs as plain
+        data, so untrusted input cannot make us attach to arbitrary
+        local segments."""
         self._shm_tx = True
+        self._shm_rx = True
 
     def _segment_for(self, size):
         from multiprocessing import shared_memory
@@ -79,23 +86,36 @@ class Protocol(object):
             create=True, size=max(size, self.SHM_THRESHOLD))
         return self._segment
 
-    def _offload(self, message):
-        if not isinstance(message, dict):
-            return message
-        out = {}
+    def _collect_blobs(self, message, found):
+        """Gather offload-eligible blob paths (two-pass: the segment
+        must be sized for ALL of a message's blobs before writing —
+        one blob per message is the common case, but a regrow between
+        writes would unlink bytes an earlier ref still points to)."""
         for key, value in message.items():
             if key == "blob" and isinstance(value, str) \
                     and len(value) >= self.SHM_THRESHOLD:
-                data = value.encode("utf-8")  # blobs may be any text
-                seg = self._segment_for(len(data))
-                seg.buf[:len(data)] = data
-                self.shm_sends += 1
-                out[key] = {"__shm__": seg.name, "size": len(data)}
+                found.append((message, key, value.encode("utf-8")))
             elif isinstance(value, dict):
-                out[key] = self._offload(value)
-            else:
-                out[key] = value
-        return out
+                self._collect_blobs(value, found)
+
+    def _offload(self, message):
+        if not isinstance(message, dict):
+            return message
+        import copy
+        message = copy.deepcopy(message)
+        found = []
+        self._collect_blobs(message, found)
+        if not found:
+            return message
+        seg = self._segment_for(sum(len(data) for _, _, data in found))
+        offset = 0
+        for container, key, data in found:
+            seg.buf[offset:offset + len(data)] = data
+            container[key] = {"__shm__": seg.name, "off": offset,
+                              "size": len(data)}
+            offset += len(data)
+            self.shm_sends += 1
+        return message
 
     @classmethod
     def _restore(cls, message):
@@ -105,7 +125,10 @@ class Protocol(object):
         for key, value in message.items():
             if isinstance(value, dict) and "__shm__" in value:
                 from multiprocessing import shared_memory
-                seg = shared_memory.SharedMemory(name=value["__shm__"])
+                try:
+                    seg = shared_memory.SharedMemory(name=value["__shm__"])
+                except (OSError, ValueError) as e:
+                    raise ConnectionError("stale sharedio ref: %s" % e)
                 try:
                     # CPython's SharedMemory registers every attach with
                     # THIS process's resource tracker, which would
@@ -116,8 +139,9 @@ class Protocol(object):
                 except Exception:
                     pass
                 try:
-                    out[key] = bytes(seg.buf[:value["size"]]
-                                     ).decode("utf-8")
+                    off = int(value.get("off", 0))
+                    out[key] = bytes(
+                        seg.buf[off:off + value["size"]]).decode("utf-8")
                 finally:
                     seg.close()  # sender owns the segment; never unlink
             elif isinstance(value, dict):
@@ -142,7 +166,7 @@ class Protocol(object):
         if not line:
             raise ConnectionError("peer closed")
         message = json.loads(line)
-        if self._has_shm_ref(message):
+        if self._shm_rx and self._has_shm_ref(message):
             self.shm_reads += 1
             return self._restore(message)
         return message
@@ -545,8 +569,14 @@ class CoordinatorClient(Logger):
                 self.proto.close()
                 raise RuntimeError("chaos death")
             result = handler(job)
-            self.proto.send({"cmd": "result", "data": result})
-            self.proto.recv()
+            try:
+                self.proto.send({"cmd": "result", "data": result})
+                self.proto.recv()
+            except (ConnectionError, OSError):
+                # master shut down while we were computing — a normal
+                # end-of-run, not an error (the result is lost, but the
+                # master only closes once it has all it needs)
+                return self.jobs_done
             self.jobs_done += 1
 
     def heartbeat(self):
