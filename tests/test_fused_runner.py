@@ -1,0 +1,200 @@
+"""The production fused path: Launcher/CLI default to the step compiler
+with eager-identical side effects (VERDICT round-1 item #2)."""
+
+import json
+
+import numpy
+import pytest
+
+from test_mnist_e2e import synthetic_digits
+
+from veles_tpu import prng
+from veles_tpu.launcher import Launcher
+from veles_tpu.models.mnist import MnistWorkflow
+from veles_tpu.train import FusedRunner, fused_compatible
+
+
+def _launch(max_epochs=3, eager=False, seed=42):
+    prng.get().seed(seed)
+    prng.get("loader").seed(seed + 1)
+    launcher = Launcher(graphics=False, eager=eager)
+    wf = MnistWorkflow(launcher, provider=synthetic_digits(),
+                       layers=(32,), minibatch_size=60,
+                       learning_rate=0.08, max_epochs=max_epochs)
+    launcher.initialize()
+    launcher.run()
+    return wf
+
+
+def test_launcher_default_is_fused_and_matches_eager():
+    """Same CLI entry, fused by default: losses must track eager."""
+    wf_eager = _launch(eager=True)
+    wf_fused = _launch(eager=False)
+    h_eager = wf_eager.decision.epoch_history
+    h_fused = wf_fused.decision.epoch_history
+    assert [h["epoch"] for h in h_fused] == [h["epoch"] for h in h_eager]
+    for he, hf in zip(h_eager, h_fused):
+        for klass in ("validation", "train"):
+            numpy.testing.assert_allclose(
+                hf[klass]["normalized"], he[klass]["normalized"],
+                atol=0.02)
+            assert hf[klass]["samples"] == he[klass]["samples"]
+    # decision state mirrors eager too
+    assert wf_fused.decision.best_epoch == wf_eager.decision.best_epoch
+    assert bool(wf_fused.stopped) and bool(wf_fused.decision.complete)
+    # trained weights were pushed back into the unit arrays
+    we = numpy.asarray(wf_eager.forwards[0].weights.map_read())
+    wfu = numpy.asarray(wf_fused.forwards[0].weights.map_read())
+    numpy.testing.assert_allclose(wfu, we, atol=0.02)
+
+
+def test_fused_runner_fires_services(tmp_path):
+    """Plotters and the snapshotter hang off the decision and must fire
+    once per epoch, exactly like the eager scheduler's epoch boundary."""
+    from veles_tpu.snapshotter import SnapshotterToFile
+
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    launcher = Launcher(graphics=False)
+    wf = MnistWorkflow(launcher, provider=synthetic_digits(),
+                       layers=(16,), minibatch_size=60,
+                       learning_rate=0.08, max_epochs=2)
+    snap = SnapshotterToFile(wf, directory=str(tmp_path), interval=1,
+                             time_interval=0.0, name="snapshotter")
+    snap.link_from(wf.decision)
+    snap.gate_skip = ~wf.loader.epoch_ended
+    launcher.initialize()
+    wf.add_plotters(klasses=("validation",))  # incl. confusion plotter
+    assert fused_compatible(wf) is None
+    launcher.run()
+    assert len(wf.decision.epoch_history) == 2
+    assert snap.destination is not None
+    assert snap.run_calls == 2
+    assert all(p.run_calls == 2 for p in wf.plotters)
+    # the fused path computed the confusion matrix the plotter reads
+    conf = wf.evaluator.confusion_matrix
+    assert conf is not None and conf.sum() == \
+        wf.loader.class_lengths[1]  # whole validation class
+    # evaluator summary metrics (result providers read these) are live
+    assert wf.evaluator.loss > 0.0
+
+
+def test_fused_compatible_rejects_nonstandard_graph():
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.units import Unit
+
+    prng.get().seed(1)
+    prng.get("loader").seed(2)
+    wf = MnistWorkflow(DummyLauncher(), provider=synthetic_digits(),
+                       layers=(8,), minibatch_size=60, max_epochs=1)
+
+    class Custom(Unit):
+        hide_from_registry = True
+
+        def run(self):
+            pass
+
+    custom = Custom(wf, name="custom")
+    custom.link_from(wf.decision)
+    wf.initialize(device=Device(backend="cpu"))
+    reason = fused_compatible(wf)
+    assert reason is not None and "custom" in reason
+
+
+def test_fused_testing_mode():
+    """--test: forward-only single epoch through the fused evaluator."""
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    launcher = Launcher(graphics=False, testing=True)
+    wf = MnistWorkflow(launcher, provider=synthetic_digits(),
+                       layers=(16,), minibatch_size=60,
+                       learning_rate=0.08, max_epochs=5)
+    before = None
+    launcher.initialize()
+    before = numpy.asarray(wf.forwards[0].weights.map_read()).copy()
+    launcher.run()
+    history = wf.decision.epoch_history
+    assert len(history) == 1
+    assert "train" in history[0]  # test pass covers the train class too
+    after = numpy.asarray(wf.forwards[0].weights.map_read())
+    numpy.testing.assert_array_equal(before, after)  # no updates
+
+
+def test_cli_eager_flag(tmp_path):
+    """--eager produces the same results file as the fused default."""
+    from test_launcher import WORKFLOW_FILE
+    from veles_tpu.__main__ import Main
+
+    path = tmp_path / "tiny_workflow.py"
+    path.write_text(WORKFLOW_FILE)
+    out_fused = str(tmp_path / "fused.json")
+    out_eager = str(tmp_path / "eager.json")
+    m_fused = Main()
+    assert m_fused.run([str(path), "-s", "7",
+                        "--result-file", out_fused]) == 0
+    assert m_fused.launcher.run_mode_used == "fused"
+    m_eager = Main()
+    assert m_eager.run([str(path), "-s", "7", "--eager",
+                        "--result-file", out_eager]) == 0
+    assert m_eager.launcher.run_mode_used == "eager"
+    fused = json.load(open(out_fused))
+    eager = json.load(open(out_eager))
+    assert fused["epochs"] == eager["epochs"]
+    assert fused["best_n_err_pt"] == pytest.approx(
+        eager["best_n_err_pt"], abs=0.05)
+    # the evaluator's last-minibatch summary metrics ride along too
+    assert fused["n_err"] == pytest.approx(eager["n_err"], abs=3)
+    assert fused["loss"] > 0.0
+
+
+def test_fused_gate_block_stops_propagation():
+    """A gate_block'ed service swallows its signal: units downstream of
+    it must not fire — the eager _drain contract."""
+    from veles_tpu.units import Unit
+
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    launcher = Launcher(graphics=False)
+    wf = MnistWorkflow(launcher, provider=synthetic_digits(),
+                       layers=(16,), minibatch_size=60,
+                       learning_rate=0.08, max_epochs=2)
+
+    class Probe(Unit):
+        hide_from_registry = True
+        view_group = "SERVICE"
+
+        def run(self):
+            pass
+
+    blocked = Probe(wf, name="blocked")
+    blocked.link_from(wf.decision)
+    blocked.gate_block = wf.decision.improved  # block on improvement
+    downstream = Probe(wf, name="downstream")
+    downstream.link_from(blocked)
+    launcher.initialize()
+    launcher.run()
+    assert launcher.run_mode_used == "fused"
+    # synthetic digits improve every epoch -> blocked never fired,
+    # and neither did its dependent
+    assert blocked.run_calls == 0
+    assert downstream.run_calls == 0
+
+
+def test_fused_runner_resumes_finished_snapshot():
+    """Re-running a finished workflow with a higher epoch budget must
+    continue from the wrap point, as the eager loader would."""
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    launcher = Launcher(graphics=False)
+    wf = MnistWorkflow(launcher, provider=synthetic_digits(),
+                       layers=(16,), minibatch_size=60,
+                       learning_rate=0.08, max_epochs=1)
+    launcher.initialize()
+    launcher.run()
+    assert len(wf.decision.epoch_history) == 1
+    # raise the budget and run again (what -w + higher max_epochs does)
+    wf.decision.max_epochs = 3
+    wf.decision.complete.value = False
+    FusedRunner(wf).run()
+    assert [h["epoch"] for h in wf.decision.epoch_history] == [0, 1, 2]

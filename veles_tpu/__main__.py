@@ -155,6 +155,7 @@ class Main(Logger):
             "web_status": getattr(args, "web_status", False),
             "nodes": getattr(args, "nodes", None),
             "respawn": getattr(args, "respawn", False),
+            "eager": getattr(args, "eager", False),
         }
         if args.listen_address:
             kwargs["listen_address"] = args.listen_address

@@ -59,7 +59,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         "listen_address", "master_address", "device", "backend", "testing",
         "stealth", "web_status", "graphics", "slave_death_probability",
         "job_timeout", "heartbeat_timeout", "max_idle",
-        "nodes", "respawn", "slave_command",
+        "nodes", "respawn", "slave_command", "eager",
     ])
 
     def __init__(self, **kwargs):
@@ -85,6 +85,9 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.max_idle = kwargs.get("max_idle")
         self.nodes = kwargs.get("nodes")
         self.respawn = kwargs.get("respawn", False)
+        self.eager = kwargs.get("eager", False)
+        #: "fused" | "eager" once the standalone run path is chosen
+        self.run_mode_used = None
         self.slave_command = kwargs.get("slave_command")
         self._node_launcher = None
         self.id = str(uuid.uuid4())
@@ -131,6 +134,11 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         parser.add_argument(
             "--web-status", action="store_true",
             help="post periodic status JSON to the web dashboard")
+        parser.add_argument(
+            "--eager", action="store_true",
+            help="run the eager per-unit scheduler instead of the fused "
+                 "XLA step compiler (the default for standard-shaped "
+                 "workflows)")
         return parser
 
     # -- mode --------------------------------------------------------------
@@ -324,10 +332,28 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             elif self.is_slave:
                 self._run_slave()
             else:
-                self.workflow.run()
+                self._run_standalone()
         finally:
             self.stop()
         return self.workflow
+
+    def _run_standalone(self):
+        """Fused step-compiled training by default; eager on ``--eager``
+        or when the graph does not fit the step compiler's contract."""
+        workflow = self.workflow
+        if self.eager:
+            self.info("running the eager per-unit scheduler (--eager)")
+            self.run_mode_used = "eager"
+            return workflow.run()
+        from veles_tpu.train.runner import FusedRunner, fused_compatible
+        reason = fused_compatible(workflow)
+        if reason is not None:
+            self.info("fused path unavailable (%s); running eager", reason)
+            self.run_mode_used = "eager"
+            return workflow.run()
+        self.info("running the fused XLA step compiler")
+        self.run_mode_used = "fused"
+        return FusedRunner(workflow).run()
 
     def _run_master(self):
         # master does no compute: wait until the workflow declares

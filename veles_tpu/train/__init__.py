@@ -12,3 +12,5 @@ model *description* (and the parity/debug path); this is the model
 """
 
 from veles_tpu.train.step import FusedTrainer  # noqa: F401
+from veles_tpu.train.runner import (FusedRunner,  # noqa: F401
+                                    fused_compatible)

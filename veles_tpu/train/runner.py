@@ -1,0 +1,290 @@
+"""FusedRunner: the production driver of the step compiler.
+
+The reference promises that the SAME entry point is the fast path
+(``veles/__main__.py:820-856`` dispatches straight into the Twisted
+run loop that drives the OpenCL/CUDA kernels).  Here the fast path is
+the fused XLA step (:mod:`veles_tpu.train.step`), and this module makes
+``python -m veles_tpu`` / :class:`~veles_tpu.launcher.Launcher` use it
+by default whenever the workflow has the standard trainable shape:
+
+    loader + forwards + evaluator(softmax|mse) + gds + decision
+
+Everything the eager graph would do at epoch boundaries still happens,
+through the SAME units: the decision's canonical bookkeeping
+(``epoch_stats`` → ``_on_class_finished`` → ``_on_epoch_finished``,
+giving identical ``epoch_history``, ``improved``/``best_*`` state, stop
+criterion and log lines), and every service unit hanging off the graph
+(plotters, snapshotter, ...) fires once per epoch with the loader's
+``epoch_ended``/``last_minibatch`` flags raised — exactly the state the
+eager scheduler shows them on the last minibatch of an epoch.
+
+Nonstandard graphs (custom units on the training path, mid-epoch
+snapshot resumes, unsupported evaluators) are detected by
+:func:`fused_compatible` and fall back to the eager per-unit scheduler,
+as does the explicit ``--eager`` flag.
+"""
+
+import collections
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
+from veles_tpu.logger import Logger
+from veles_tpu.nn.dropout import DropoutForward
+from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
+from veles_tpu.plotting_units import MatrixPlotter
+from veles_tpu.plumbing import Repeater, StartPoint, EndPoint
+from veles_tpu.train.step import FusedTrainer
+
+#: view groups whose units are epoch-boundary services — safe to fire
+#: once per fused epoch instead of once per minibatch
+SERVICE_VIEW_GROUPS = ("PLOTTER", "SERVICE")
+
+
+def _covered_units(workflow):
+    """Units whose work the fused step subsumes."""
+    covered = {workflow.start_point, workflow.end_point,
+               workflow.loader, workflow.evaluator, workflow.decision}
+    covered.update(workflow.forwards)
+    covered.update(getattr(workflow, "gds", ()))
+    for unit in workflow:
+        if isinstance(unit, (Repeater, StartPoint, EndPoint)):
+            covered.add(unit)
+    return covered
+
+
+def fused_compatible(workflow):
+    """None if ``workflow`` can run fused, else a human-readable reason.
+
+    Conservative on purpose: any unit the step compiler does not model
+    (other than pure epoch-boundary services) forces the eager path, so
+    user graphs with custom per-minibatch units keep their semantics.
+    """
+    for attr in ("loader", "forwards", "evaluator", "decision"):
+        if getattr(workflow, attr, None) is None:
+            return "workflow has no %s" % attr
+    if not workflow.forwards:
+        return "workflow has an empty forward chain"
+    evaluator = workflow.evaluator
+    if not isinstance(evaluator, (EvaluatorSoftmax, EvaluatorMSE)):
+        return "evaluator %s is not softmax/mse" % type(evaluator).__name__
+    loader = workflow.loader
+    for attr in ("original_data", "shuffled_indices", "class_lengths",
+                 "max_minibatch_size"):
+        if getattr(loader, attr, None) is None:
+            return "loader lacks %s" % attr
+    truth_attr = ("original_labels" if isinstance(evaluator,
+                                                  EvaluatorSoftmax)
+                  else "original_targets")
+    truth = getattr(loader, truth_attr, None)
+    if truth is None or getattr(truth, "mem", None) is None:
+        return "loader has no device-resident %s" % truth_attr
+    if getattr(loader.original_data, "mem", None) is None:
+        return "loader dataset is not device-resident"
+    offset = getattr(loader, "_global_offset", 0)
+    if 0 < offset < loader.total_samples:
+        return "loader resumed mid-epoch (offset %d)" % offset
+    covered = _covered_units(workflow)
+    for unit in workflow:
+        if unit in covered:
+            continue
+        if unit.view_group in SERVICE_VIEW_GROUPS:
+            continue
+        return "unit %r (%s, view_group=%s) is outside the fused step" % (
+            unit.name, type(unit).__name__, unit.view_group)
+    return None
+
+
+class FusedRunner(Logger):
+    """Drive a standard workflow through compiled segments, firing the
+    decision and the service units exactly as the eager scheduler would
+    at each epoch boundary."""
+
+    def __init__(self, workflow, trainer=None):
+        super(FusedRunner, self).__init__()
+        self.workflow = workflow
+        self.trainer = trainer if trainer is not None \
+            else FusedTrainer(workflow)
+        self._last_batch = (0.0, 0.0)
+
+    # -- epoch bodies ------------------------------------------------------
+
+    def _eval_classes(self, params, testing):
+        """Forward-only passes in the eager serving order."""
+        trainer = self.trainer
+        loader = trainer.loader
+        stats = {}
+        klasses = (TEST, VALIDATION, TRAIN) if testing \
+            else (TEST, VALIDATION)
+        for klass in klasses:
+            if not loader.class_lengths[klass]:
+                continue
+            idx = trainer._segment_indices(klass)
+            losses, metrics = trainer._eval_segment(params,
+                                                    jnp.asarray(idx))
+            stats[klass] = trainer._summarize(losses, metrics, klass)
+            self._last_batch = (float(losses[-1]), float(metrics[-1]))
+        return stats
+
+    def _train_class(self, params, states):
+        trainer = self.trainer
+        loader = trainer.loader
+        idx = trainer._segment_indices(TRAIN)
+        if any(isinstance(f, DropoutForward) for f in trainer.forwards):
+            base = prng.get(loader.rand_name).jax_key()
+        else:
+            # keys are dead in the trace without dropout; not drawing
+            # keeps the loader's shuffle stream bit-identical to eager
+            base = jax.random.PRNGKey(0)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(idx.shape[0]))
+        params, states, losses, metrics = trainer._train_segment(
+            params, states, jnp.asarray(idx), keys)
+        self._last_batch = (float(losses[-1]), float(metrics[-1]))
+        return params, states, trainer._summarize(losses, metrics, TRAIN)
+
+    # -- epoch-boundary side effects ---------------------------------------
+
+    def _close_epoch(self, stats):
+        """Replay the decision unit's last-minibatch bookkeeping.
+
+        Same calls the eager path makes (decision.py run():82-88), so
+        epoch_history entries, improved/best_* state, stop decisions and
+        log lines are identical between the two schedulers."""
+        decision = self.workflow.decision
+        loader = self.workflow.loader
+        for klass in (TEST, VALIDATION, TRAIN):
+            if klass not in stats:
+                continue
+            epoch_stats = decision.epoch_stats[klass]
+            epoch_stats["samples"] = stats[klass]["samples"]
+            epoch_stats["metric"] = stats[klass]["metric"]
+            decision._on_class_finished(klass)
+        loader.samples_served += sum(
+            s["samples"] for s in stats.values())
+        # evaluator summary state the eager path leaves behind (its last
+        # minibatch's values) — result providers read these
+        evaluator = self.workflow.evaluator
+        last_loss, last_metric = self._last_batch
+        if isinstance(evaluator, EvaluatorSoftmax):
+            evaluator.loss = last_loss
+            evaluator.n_err = int(last_metric)
+        else:
+            evaluator.rmse = float(max(last_loss, 0.0)) ** 0.5
+        # the eager loader state at an epoch's last minibatch — so a
+        # snapshot taken here resumes exactly like an eager one
+        loader._global_offset = loader.total_samples
+        loader.minibatch_offset = loader.total_samples
+        loader.last_minibatch <<= True
+        loader.epoch_ended <<= True
+        decision._on_epoch_finished()
+
+    def _fire_services(self, services):
+        """One epoch-boundary pass over the service subgraph with the
+        eager scheduler's exact signal semantics (workflow.py _drain):
+        gate_block swallows the signal (dependents never fire),
+        gate_skip propagates without running."""
+        service_set = set(services)
+        signals = collections.deque()
+        for unit in services:
+            for src in unit.links_from:
+                if src not in service_set:
+                    # the fused step stands in for every covered unit's
+                    # firing on the epoch's last minibatch
+                    signals.append((unit, src))
+        while signals:
+            dst, src = signals.popleft()
+            if dst not in service_set:
+                continue
+            if bool(dst.gate_block):
+                continue
+            if not dst.open_gate(src):
+                continue
+            if bool(dst.gate_skip):
+                for nxt in dst.links_to:
+                    signals.append((nxt, dst))
+                continue
+            dst._run_wrapped()
+            for nxt in dst.links_to:
+                signals.append((nxt, dst))
+
+    def _feed_confusion(self, params):
+        """Confusion plotters need evaluator.confusion_matrix, which only
+        the eager evaluator fills; compute it fused (whole validation —
+        or train — class, superseding eager's last-minibatch snapshot)."""
+        trainer = self.trainer
+        loader = trainer.loader
+        klass = VALIDATION if loader.class_lengths[VALIDATION] else TRAIN
+        if not loader.class_lengths[klass]:
+            return
+        idx = trainer._segment_indices(klass)
+        self.workflow.evaluator.confusion_matrix = numpy.asarray(
+            trainer.confusion_segment(params, idx))
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self):
+        workflow = self.workflow
+        loader = workflow.loader
+        decision = workflow.decision
+        trainer = self.trainer
+        services = [u for u in workflow.units_in_dependency_order
+                    if u not in _covered_units(workflow)]
+        workflow.event("run", "begin")
+        workflow.stopped <<= False
+        workflow.is_running = True
+        start = time.perf_counter()
+        epochs_done = 0
+        samples_done = 0
+        needs_confusion = (
+            trainer.loss_kind == "softmax" and
+            getattr(workflow.evaluator, "compute_confusion", False) and
+            any(isinstance(u, MatrixPlotter) for u in services))
+        try:
+            params, states = trainer.pull_params()
+            while True:
+                if bool(decision.complete) or bool(workflow.stopped):
+                    # e.g. a resumed snapshot of a finished run: the
+                    # eager end_point would fire immediately, with the
+                    # loader state untouched
+                    break
+                if loader.total_samples and \
+                        getattr(loader, "_global_offset", 0) >= \
+                        loader.total_samples:
+                    # the eager loader's lazy epoch wrap on next serve
+                    # (loader/base.py _advance_global_offset:179-180)
+                    loader._finish_epoch()
+                    loader.epoch_ended <<= False
+                    loader.last_minibatch <<= False
+                testing = bool(decision.testing)
+                stats = self._eval_classes(params, testing)
+                if not testing and loader.class_lengths[TRAIN]:
+                    params, states, train_stats = self._train_class(
+                        params, states)
+                    stats[TRAIN] = train_stats
+                if needs_confusion:
+                    self._feed_confusion(params)
+                self._close_epoch(stats)
+                if services:
+                    # services may pickle/plot the unit arrays, whose
+                    # previous buffers the compiled segment donated —
+                    # rebind them to the live params first
+                    trainer.push_params(params, states)
+                self._fire_services(services)
+                epochs_done += 1
+                samples_done += sum(s["samples"] for s in stats.values())
+        finally:
+            workflow.is_running = False
+            elapsed = time.perf_counter() - start
+            workflow._run_time += elapsed
+            workflow.event("run", "end")
+        trainer.push_params(params, states)
+        workflow.on_workflow_finished()
+        self.info("fused run: %d epochs, %d samples in %.2fs "
+                  "(%.0f samples/s)", epochs_done, samples_done, elapsed,
+                  samples_done / max(elapsed, 1e-9))
+        return workflow

@@ -106,6 +106,15 @@ class FusedTrainer(Logger):
         metric = jnp.sum(jnp.mean(jnp.square(diff), axis=1))
         return grad_loss, metric / n_valid, metric
 
+    @staticmethod
+    def _gather(data_args, idx):
+        dataset, truth_src = data_args
+        data = jnp.take(dataset, jnp.maximum(idx, 0), axis=0)
+        data = data * (idx >= 0).reshape(
+            (-1,) + (1,) * (data.ndim - 1)).astype(data.dtype)
+        truth = jnp.take(truth_src, jnp.maximum(idx, 0), axis=0)
+        return data, truth
+
     def _build(self):
         if isinstance(self.evaluator, EvaluatorSoftmax):
             self.loss_kind = "softmax"
@@ -136,13 +145,7 @@ class FusedTrainer(Logger):
             if self.loss_kind == "softmax"
             else self.loader.original_targets.devmem)
 
-        def gather(data_args, idx):
-            dataset, truth_src = data_args
-            data = jnp.take(dataset, jnp.maximum(idx, 0), axis=0)
-            data = data * (idx >= 0).reshape(
-                (-1,) + (1,) * (data.ndim - 1)).astype(data.dtype)
-            truth = jnp.take(truth_src, jnp.maximum(idx, 0), axis=0)
-            return data, truth
+        gather = self._gather
 
         def train_batch(data_args, carry, batch_in):
             params_list, opt_states = carry
@@ -204,6 +207,36 @@ class FusedTrainer(Logger):
             return jit_eval(self._data_args, params_list, idx_matrix)
 
         self._eval_segment = _eval_segment_call
+
+    def confusion_segment(self, params_list, idx_matrix):
+        """Summed confusion matrix of a forward pass over a segment.
+
+        Lazily compiled; only the fused production runner asks for it
+        (when a confusion plotter hangs off the graph). Whole-segment
+        accumulation supersedes the eager evaluator's last-minibatch
+        snapshot of ``confusion_matrix``."""
+        if self.loss_kind != "softmax":
+            raise TypeError("confusion requires a softmax evaluator")
+        fn = getattr(self, "_conf_fn", None)
+        if fn is None:
+            def conf_pure(data_args, params_list, idx_matrix):
+                def body(_, idx):
+                    x, truth = self._gather(data_args, idx)
+                    valid = idx >= 0
+                    out = self._forward(params_list, x, None, train=False)
+                    probs = out.reshape(out.shape[0], -1)
+                    n_classes = probs.shape[-1]
+                    pred = jnp.argmax(probs, axis=1)
+                    safe = jnp.where(valid, truth, 0)
+                    flat = safe * n_classes + pred
+                    conf = jnp.zeros((n_classes * n_classes,),
+                                     jnp.int32).at[flat].add(
+                        valid.astype(jnp.int32))
+                    return None, conf.reshape(n_classes, n_classes)
+                _, confs = jax.lax.scan(body, None, idx_matrix)
+                return jnp.sum(confs, axis=0)
+            fn = self._conf_fn = jax.jit(conf_pure)
+        return fn(self._data_args, params_list, jnp.asarray(idx_matrix))
 
     # -- compilation hooks (overridden by parallel trainers) ---------------
     # signatures: train fn(data_args, params, states, idx, keys),
