@@ -3,6 +3,7 @@
 // buffers, error strings copied into caller storage.
 
 #include <cstring>
+#include <memory>
 #include <string>
 
 #include "workflow_loader.h"
@@ -26,9 +27,9 @@ extern "C" {
 
 void* vt_load(const char* path, char* err, int errlen) {
   try {
-    auto handle = new Handle;
+    auto handle = std::make_unique<Handle>();
     handle->workflow = veles_native::LoadWorkflow(path);
-    return handle;
+    return handle.release();
   } catch (const std::exception& e) {
     CopyError(e.what(), err, errlen);
     return nullptr;

@@ -159,3 +159,44 @@ def test_shm_refs_from_untrusted_peer_stay_inert():
     finally:
         tx.close()
         rx.close()
+
+
+def test_spoofed_mid_does_not_enable_sharedio(monkeypatch):
+    """A peer that self-reports the master's machine id but cannot
+    actually read the master's shm challenge must stay on the plain
+    socket path (ADVICE r1: mid is guessable and disclosed)."""
+    from veles_tpu.parallel import coordinator as coord
+
+    monkeypatch.setattr(coord, "_answer_same_host",
+                        lambda proto, challenge:
+                        {"cmd": "shm_proof", "nonce": None})
+    server = CoordinatorServer(checksum="c")
+    try:
+        client = CoordinatorClient(server.address, checksum="c").connect()
+        assert not client.proto._shm_tx
+        assert not client.proto._shm_rx
+        # the connection still works end-to-end without the fast path
+        server.submit({"blob": "x" * (256 * 1024)})
+        client.serve_forever(lambda job: {"n": len(job["blob"])},
+                             max_idle=3)
+        assert server.wait(1, timeout=5) == [{"n": 256 * 1024}]
+        assert client.proto.shm_sends == 0
+    finally:
+        server.stop()
+
+
+def test_restore_rejects_out_of_bounds_refs():
+    """off/size outside the attached segment must raise, not silently
+    truncate into a corrupt blob."""
+    from multiprocessing import shared_memory
+    from veles_tpu.parallel.coordinator import Protocol
+
+    seg = shared_memory.SharedMemory(create=True, size=64)
+    try:
+        for off, size in ((0, 65), (-1, 4), (60, 8), (0, -1)):
+            with pytest.raises(ConnectionError, match="bounds"):
+                Protocol._restore({"payload": {
+                    "__shm__": seg.name, "off": off, "size": size}})
+    finally:
+        seg.close()
+        seg.unlink()

@@ -161,3 +161,31 @@ def test_exported_model_through_forge(forge, tmp_path):
     client.fetch("trained-mnist", str(dest))
     assert (dest / "contents.json").exists()
     assert any(fn.startswith("@") for fn in os.listdir(dest))
+
+
+def test_delete_via_get_is_refused(forge):
+    """delete is state-changing: it must not be reachable through a
+    cacheable/prefetchable GET (ADVICE r1)."""
+    import urllib.error
+    import urllib.request
+    server, client, tmp_path = forge
+    client.upload(_make_package(tmp_path, name="getdel"))
+    url = ("http://127.0.0.1:%d/forge?query=delete&name=getdel"
+           "&token=sekret" % server.port)
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(url, timeout=10)
+    # still there — the GET changed nothing
+    assert any(m["name"] == "getdel" for m in client.list())
+    # the supported POST form works
+    client.delete("getdel")
+    assert not any(m["name"] == "getdel" for m in client.list())
+
+
+def test_tokenless_non_loopback_bind_refused(tmp_path):
+    from veles_tpu.forge.server import ForgeServer
+    with pytest.raises(ValueError, match="refusing"):
+        ForgeServer(str(tmp_path), host="0.0.0.0", port=0, token=None)
+    # explicit opt-out still works
+    s = ForgeServer(str(tmp_path), host="0.0.0.0", port=0, token=None,
+                    allow_insecure=True)
+    s._server.server_close()

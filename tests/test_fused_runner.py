@@ -198,3 +198,54 @@ def test_fused_runner_resumes_finished_snapshot():
     wf.decision.complete.value = False
     FusedRunner(wf).run()
     assert [h["epoch"] for h in wf.decision.epoch_history] == [0, 1, 2]
+
+
+def test_confusion_filled_without_plotter():
+    """Eager fills evaluator.confusion_matrix whenever
+    compute_confusion=True, plotters or not — the fused default must
+    too (code-review r2), and from one forward sweep (it rides the
+    eval scan)."""
+    wf = _launch(max_epochs=2)
+    assert not any(
+        type(u).__name__ == "MatrixPlotter" for u in wf)
+    conf = wf.evaluator.confusion_matrix
+    assert conf is not None
+    assert conf.sum() == wf.loader.class_lengths[1]
+
+
+def test_dropout_does_not_perturb_loader_stream():
+    """The fused dropout key must come from the dropout unit's own
+    stream: with dropout in the graph, the loader's shuffle sequence
+    must stay bit-identical to an eager run of the same seed
+    (code-review r2)."""
+    import numpy as np
+
+    from veles_tpu.models.mnist import MnistLoader
+    from veles_tpu.nn.dropout import DropoutForward
+    from veles_tpu.standard_workflow import StandardWorkflow
+
+    def build(eager):
+        prng.get().seed(7)
+        prng.get("loader").seed(8)
+        launcher = Launcher(graphics=False, eager=eager)
+        wf = StandardWorkflow(
+            launcher,
+            loader=lambda w: MnistLoader(w, provider=synthetic_digits(),
+                                         minibatch_size=60),
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 24},
+                {"type": "dropout", "dropout_ratio": 0.3},
+                {"type": "softmax", "output_sample_shape": 10},
+            ],
+            loss="softmax", learning_rate=0.05, max_epochs=3)
+        launcher.initialize()
+        launcher.run()
+        return wf, launcher
+
+    wf_fused, launcher = build(eager=False)
+    assert any(isinstance(f, DropoutForward) for f in wf_fused.forwards)
+    assert launcher.run_mode_used == "fused"
+    fused_idx = np.asarray(wf_fused.loader.shuffled_indices.map_read())
+    wf_eager, _ = build(eager=True)
+    eager_idx = np.asarray(wf_eager.loader.shuffled_indices.map_read())
+    np.testing.assert_array_equal(fused_idx, eager_idx)

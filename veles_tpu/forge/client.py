@@ -62,9 +62,17 @@ class ForgeClient(Logger):
                                            urllib.parse.quote(name))
         if version:
             url += "&version=" + urllib.parse.quote(version)
-        if self.token:
-            url += "&token=" + urllib.parse.quote(self.token)
-        return self._get_json(url)
+        # state-changing → POST; token in a header, never the URL
+        request = urllib.request.Request(
+            url, data=b"", headers=self._auth_headers())
+        try:
+            with urllib.request.urlopen(request, timeout=30) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(self._http_error(e))
+
+    def _auth_headers(self):
+        return {"X-Forge-Token": self.token} if self.token else {}
 
     def upload(self, path):
         """Upload a package directory (must contain manifest.json)."""
@@ -77,11 +85,10 @@ class ForgeClient(Logger):
                 # recursive: packages may carry plots/, data/ subtrees
                 tar.add(os.path.join(path, fn), arcname=fn)
         url = self.base + "/upload"
-        if self.token:
-            url += "?token=" + urllib.parse.quote(self.token)
+        headers = {"Content-Type": "application/x-tar"}
+        headers.update(self._auth_headers())
         request = urllib.request.Request(
-            url, data=buf.getvalue(),
-            headers={"Content-Type": "application/x-tar"})
+            url, data=buf.getvalue(), headers=headers)
         try:
             with urllib.request.urlopen(request, timeout=60) as resp:
                 reply = json.loads(resp.read())

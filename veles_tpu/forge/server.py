@@ -31,11 +31,24 @@ class ForgeServer(Logger):
     ``meta.json`` (version journal, latest pointer).
     """
 
-    def __init__(self, storage_dir, host="127.0.0.1", port=0, token=None):
+    def __init__(self, storage_dir, host="127.0.0.1", port=0, token=None,
+                 allow_insecure=False):
         super(ForgeServer, self).__init__()
         self.storage_dir = os.path.abspath(storage_dir)
         os.makedirs(self.storage_dir, exist_ok=True)
         self.token = token
+        if token is None:
+            if host not in ("127.0.0.1", "localhost", "::1") \
+                    and not allow_insecure:
+                # tokenless means anyone who reaches the port can upload
+                # or delete models — never expose that beyond loopback
+                # without an explicit opt-in
+                raise ValueError(
+                    "refusing to bind %s without --token; pass "
+                    "--allow-insecure (allow_insecure=True) to "
+                    "override" % host)
+            self.warning("no --token configured: uploads and deletes "
+                         "are unauthenticated")
         self._lock = threading.RLock()
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.owner = self
@@ -178,7 +191,10 @@ class ForgeServer(Logger):
             return {"deleted": name, "version": version}
 
     def _check_token(self, token):
-        if self.token is not None and token != self.token:
+        import hmac
+        if self.token is not None and (
+                not isinstance(token, str) or
+                not hmac.compare_digest(token, self.token)):
             raise PermissionError("bad or missing token")
 
     # -- lifecycle ---------------------------------------------------------
@@ -231,10 +247,6 @@ class _Handler(BaseHTTPRequestHandler):
                     self._reply(owner.list_models())
                 elif q == "details":
                     self._reply(owner.details(query.get("name", "")))
-                elif q == "delete":
-                    self._reply(owner.delete(query.get("name", ""),
-                                             token=query.get("token"),
-                                             version=query.get("version")))
                 else:
                     raise ValueError("unknown query %r" % q)
             elif parsed.path == "/fetch":
@@ -259,10 +271,21 @@ class _Handler(BaseHTTPRequestHandler):
         except (TypeError, ValueError):
             length = 0
         blob = self.rfile.read(length)
+        # token rides a header, not the URL: query strings end up in
+        # access logs, browser history and proxy caches
+        token = self.headers.get("X-Forge-Token")
+        owner = self.server.owner
+        service = "/" + root.common.forge.get("service_name", "forge")
         try:
             if parsed.path == "/upload":
-                self._reply(self.server.owner.upload(
-                    blob, token=query.get("token")))
+                self._reply(owner.upload(blob, token=token))
+            elif parsed.path == service and \
+                    query.get("query") == "delete":
+                # state-changing: POST only (a GET delete is cacheable
+                # and prefetchable)
+                self._reply(owner.delete(query.get("name", ""),
+                                         token=token,
+                                         version=query.get("version")))
             else:
                 self._reply({"error": "not found"}, code=404)
         except Exception as e:
@@ -278,9 +301,14 @@ def main(argv=None):
     parser.add_argument("-p", "--port", type=int, default=8080)
     parser.add_argument("--token", default=None,
                         help="shared secret required for upload/delete")
+    parser.add_argument("--allow-insecure", action="store_true",
+                        help="bind a non-loopback host WITHOUT a token "
+                             "(anyone reaching the port can upload or "
+                             "delete models)")
     args = parser.parse_args(argv)
     server = ForgeServer(args.root, host=args.host, port=args.port,
-                         token=args.token)
+                         token=args.token,
+                         allow_insecure=args.allow_insecure)
     server.start()
     try:
         threading.Event().wait()

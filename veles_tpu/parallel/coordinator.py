@@ -21,7 +21,10 @@ one reader thread per connection on the master, a single client thread
 on the slave. Job payloads must be JSON-serializable.
 """
 
+import hmac
 import json
+import os
+import secrets
 import socket
 import threading
 import time
@@ -140,8 +143,16 @@ class Protocol(object):
                     pass
                 try:
                     off = int(value.get("off", 0))
+                    size = int(value["size"])
+                    if off < 0 or size < 0 or off + size > seg.size:
+                        # stale ref after a regrow, or a hostile peer:
+                        # a silent slice-truncation would hand a corrupt
+                        # blob to the decoder instead of failing here
+                        raise ConnectionError(
+                            "sharedio ref out of bounds: off=%d size=%d "
+                            "segment=%d" % (off, size, seg.size))
                     out[key] = bytes(
-                        seg.buf[off:off + value["size"]]).decode("utf-8")
+                        seg.buf[off:off + size]).decode("utf-8")
                 finally:
                     seg.close()  # sender owns the segment; never unlink
             elif isinstance(value, dict):
@@ -194,6 +205,75 @@ class Protocol(object):
             except (OSError, FileNotFoundError):
                 pass
             self._segment = None
+
+
+def _prove_same_host(proto):
+    """Server side of the same-host challenge.
+
+    The client's machine-id is self-reported (a guessable MAC-derived
+    value the server also discloses), so it must never gate the shm
+    fast path by itself: a remote peer spoofing it could make the
+    master attach to arbitrary named local segments. Instead the master
+    writes a random nonce into a segment IT owns and asks the peer to
+    echo it — readable only by a process on the same machine.
+    """
+    from multiprocessing import shared_memory
+    raw = secrets.token_bytes(32)
+    try:
+        seg = shared_memory.SharedMemory(create=True, size=64)
+    except OSError:
+        return False
+    try:
+        seg.buf[:len(raw)] = raw
+        proto.send({"shm_challenge": seg.name, "nonce_len": len(raw)})
+        answer = proto.recv()
+        proof = answer.get("proof") if isinstance(answer, dict) else None
+        expected = hmac.new(raw, b"veles-shm-proof",
+                            "sha256").hexdigest()
+        return isinstance(proof, str) and \
+            hmac.compare_digest(proof, expected)
+    except (ConnectionError, OSError):
+        return False
+    finally:
+        try:
+            seg.close()
+            seg.unlink()
+        except OSError:
+            pass
+
+
+def _answer_same_host(proto, challenge):
+    """Client side: prove we can read the master's nonce segment.
+
+    The answer is an HMAC keyed by the segment's bytes, never the bytes
+    themselves — a fake master naming some OTHER process's segment in
+    its challenge must not turn this into an arbitrary-shm-read oracle
+    (the server would receive only a keyed digest of that segment's
+    prefix, not its contents). A peer that cannot attach (different
+    machine, or shm unavailable) answers ``None`` and the fast path
+    stays off — plain socket framing still works."""
+    from multiprocessing import shared_memory
+    name = challenge.get("shm_challenge")
+    n = int(challenge.get("nonce_len", 0))
+    proof = None
+    if isinstance(name, str) and 0 < n <= 64:
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+        except (OSError, ValueError):
+            seg = None
+        if seg is not None:
+            try:
+                from multiprocessing import resource_tracker
+                resource_tracker.unregister(seg._name, "shared_memory")
+            except Exception:
+                pass
+            try:
+                raw = bytes(seg.buf[:min(n, seg.size)])
+                proof = hmac.new(raw, b"veles-shm-proof",
+                                 "sha256").hexdigest()
+            finally:
+                seg.close()
+    return {"cmd": "shm_proof", "proof": proof}
 
 
 class SlaveDescription(object):
@@ -364,10 +444,17 @@ class CoordinatorServer(Logger):
                 slave_desc = self.slaves[sid]
             # same machine → job/update blobs ride shared memory, only
             # the refs cross the socket (endpoint-by-locality, the
-            # reference's server.py:721-732 inproc/ipc/tcp choice)
+            # reference's server.py:721-732 inproc/ipc/tcp choice).
+            # The self-reported mid only *nominates* the fast path; it
+            # is proven with an unforgeable challenge: a random nonce
+            # written to a master-owned shm segment that only a genuine
+            # same-host peer can read back.
+            sharedio = False
             if hello.get("mid") == hex(uuid.getnode()):
+                sharedio = _prove_same_host(proto)
+            if sharedio:
                 proto.enable_sharedio()
-            reply = {"id": sid, "log_id": sid,
+            reply = {"id": sid, "log_id": sid, "sharedio": sharedio,
                      "mid": hex(uuid.getnode())}
             if self.initial_data_source is not None:
                 reply["data"] = self.initial_data_source(slave_desc)
@@ -510,17 +597,23 @@ class CoordinatorClient(Logger):
     def connect(self):
         sock = socket.create_connection(self.address, timeout=10.0)
         self.proto = Protocol(sock)
-        import os
         self.proto.send({"cmd": "handshake", "checksum": self.checksum,
                          "power": self.power,
                          "mid": hex(uuid.getnode()), "pid": os.getpid()})
         reply = self.proto.recv()
+        if isinstance(reply, dict) and "shm_challenge" in reply:
+            # master asks for proof we really share its machine (see
+            # _prove_same_host); answer and read the actual handshake
+            # reply that follows
+            self.proto.send(_answer_same_host(self.proto, reply))
+            reply = self.proto.recv()
         if "error" in reply:
             raise ConnectionError(reply["error"])
         self.id = reply["id"]
         self.initial_data = reply.get("data")
-        if reply.get("mid") == hex(uuid.getnode()):
-            # same machine as the master: updates ride shared memory
+        if reply.get("sharedio"):
+            # same machine as the master, proven by the nonce exchange:
+            # updates ride shared memory
             self.proto.enable_sharedio()
         # dedicated heartbeat channel so long handler() runs don't get
         # this slave declared dead mid-job

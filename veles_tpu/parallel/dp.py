@@ -63,9 +63,11 @@ class DataParallelTrainer(FusedTrainer):
     def _compile_eval(self, fn):
         repl = named_sharding(self.mesh)
         idx_spec = named_sharding(self.mesh, None, self.axis)
+        # out_shardings as a single spec: the eval returns 2 leaves
+        # (losses, metrics) or 3 when confusion rides the scan
         return jax.jit(fn, in_shardings=((repl, repl),
                                          self._params_spec(), idx_spec),
-                       out_shardings=(repl, repl))
+                       out_shardings=repl)
 
     def pull_params(self):
         """Re-place host-committed params onto the mesh per the declared

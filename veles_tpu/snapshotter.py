@@ -220,6 +220,20 @@ class SnapshotterToFile(SnapshotterBase):
         they left off."""
         if isinstance(uri, str) and uri.startswith(("http://",
                                                     "https://")):
+            # unpickling a snapshot EXECUTES code from it: only restore
+            # from servers you trust; over plain http a MITM gets that
+            # execution too
+            import logging
+            log = logging.getLogger("Snapshotter")
+            if uri.startswith("http://"):
+                log.warning(
+                    "restoring over plaintext http: a man-in-the-middle "
+                    "can inject a pickle that executes arbitrary code — "
+                    "use https or a local file (%s)", uri)
+            else:
+                log.warning("remote snapshot restore runs pickled code "
+                            "from %s — make sure you trust this server",
+                            uri)
             import urllib.request
             with urllib.request.urlopen(uri, timeout=60) as resp:
                 payload = resp.read()

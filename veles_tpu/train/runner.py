@@ -27,16 +27,11 @@ as does the explicit ``--eager`` flag.
 import collections
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy
 
-from veles_tpu import prng
 from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
 from veles_tpu.logger import Logger
-from veles_tpu.nn.dropout import DropoutForward
 from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
-from veles_tpu.plotting_units import MatrixPlotter
 from veles_tpu.plumbing import Repeater, StartPoint, EndPoint
 from veles_tpu.train.step import FusedTrainer
 
@@ -114,36 +109,31 @@ class FusedRunner(Logger):
     # -- epoch bodies ------------------------------------------------------
 
     def _eval_classes(self, params, testing):
-        """Forward-only passes in the eager serving order."""
+        """Forward-only passes in the eager serving order. When the
+        evaluator computes a confusion matrix, it rides along in the
+        same scan — no second forward sweep."""
         trainer = self.trainer
         loader = trainer.loader
+        evaluator = self.workflow.evaluator
         stats = {}
         klasses = (TEST, VALIDATION, TRAIN) if testing \
             else (TEST, VALIDATION)
         for klass in klasses:
             if not loader.class_lengths[klass]:
                 continue
-            idx = trainer._segment_indices(klass)
-            losses, metrics = trainer._eval_segment(params,
-                                                    jnp.asarray(idx))
+            losses, metrics, conf = trainer.eval_class(params, klass)
+            if conf is not None:
+                # later classes overwrite: confusion ends up for the
+                # most meaningful class evaluated (validation over test)
+                evaluator.confusion_matrix = numpy.asarray(conf)
             stats[klass] = trainer._summarize(losses, metrics, klass)
             self._last_batch = (float(losses[-1]), float(metrics[-1]))
         return stats
 
     def _train_class(self, params, states):
         trainer = self.trainer
-        loader = trainer.loader
-        idx = trainer._segment_indices(TRAIN)
-        if any(isinstance(f, DropoutForward) for f in trainer.forwards):
-            base = prng.get(loader.rand_name).jax_key()
-        else:
-            # keys are dead in the trace without dropout; not drawing
-            # keeps the loader's shuffle stream bit-identical to eager
-            base = jax.random.PRNGKey(0)
-        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-            jnp.arange(idx.shape[0]))
-        params, states, losses, metrics = trainer._train_segment(
-            params, states, jnp.asarray(idx), keys)
+        params, states, losses, metrics = trainer.train_class(
+            params, states)
         self._last_batch = (float(losses[-1]), float(metrics[-1]))
         return params, states, trainer._summarize(losses, metrics, TRAIN)
 
@@ -212,16 +202,15 @@ class FusedRunner(Logger):
             for nxt in dst.links_to:
                 signals.append((nxt, dst))
 
-    def _feed_confusion(self, params):
-        """Confusion plotters need evaluator.confusion_matrix, which only
-        the eager evaluator fills; compute it fused (whole validation —
-        or train — class, superseding eager's last-minibatch snapshot)."""
+    def _feed_confusion_from_train(self, params):
+        """No validation set: confusion comes from a forward sweep of
+        the TRAIN class (eval segments never see it outside testing
+        mode). The common case — a validation class — gets confusion
+        for free inside ``_eval_classes``."""
         trainer = self.trainer
-        loader = trainer.loader
-        klass = VALIDATION if loader.class_lengths[VALIDATION] else TRAIN
-        if not loader.class_lengths[klass]:
+        if not trainer.loader.class_lengths[TRAIN]:
             return
-        idx = trainer._segment_indices(klass)
+        idx = trainer._segment_indices(TRAIN)
         self.workflow.evaluator.confusion_matrix = numpy.asarray(
             trainer.confusion_segment(params, idx))
 
@@ -240,10 +229,14 @@ class FusedRunner(Logger):
         start = time.perf_counter()
         epochs_done = 0
         samples_done = 0
-        needs_confusion = (
-            trainer.loss_kind == "softmax" and
-            getattr(workflow.evaluator, "compute_confusion", False) and
-            any(isinstance(u, MatrixPlotter) for u in services))
+        # eager fills confusion_matrix whenever the evaluator asks
+        # (compute_confusion defaults True) — MatrixPlotter or not;
+        # with a validation class it rides the eval scan for free, so
+        # only the validation-less fallback costs an extra sweep
+        confusion_from_train = (
+            trainer.wants_confusion and
+            not loader.class_lengths[VALIDATION])
+        params = states = None
         try:
             params, states = trainer.pull_params()
             while True:
@@ -266,8 +259,8 @@ class FusedRunner(Logger):
                     params, states, train_stats = self._train_class(
                         params, states)
                     stats[TRAIN] = train_stats
-                if needs_confusion:
-                    self._feed_confusion(params)
+                if confusion_from_train and not testing:
+                    self._feed_confusion_from_train(params)
                 self._close_epoch(stats)
                 if services:
                     # services may pickle/plot the unit arrays, whose
@@ -278,11 +271,15 @@ class FusedRunner(Logger):
                 epochs_done += 1
                 samples_done += sum(s["samples"] for s in stats.values())
         finally:
+            # rebind unit arrays even on an exception / Ctrl-C: the
+            # epochs that DID complete must survive into any subsequent
+            # snapshot (eager keeps unit arrays current every minibatch)
+            if params is not None:
+                trainer.push_params(params, states)
             workflow.is_running = False
             elapsed = time.perf_counter() - start
             workflow._run_time += elapsed
             workflow.event("run", "end")
-        trainer.push_params(params, states)
         workflow.on_workflow_finished()
         self.info("fused run: %d epochs, %d samples in %.2fs "
                   "(%.0f samples/s)", epochs_done, samples_done, elapsed,

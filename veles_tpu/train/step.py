@@ -145,6 +145,13 @@ class FusedTrainer(Logger):
             if self.loss_kind == "softmax"
             else self.loader.original_targets.devmem)
 
+        #: fold confusion accumulation into the eval scan (one forward
+        #: sweep serves losses+metrics+confusion) whenever the evaluator
+        #: asks for it — eager fills confusion_matrix per minibatch
+        #: under the same flag (evaluator.py:153-154)
+        self.wants_confusion = self.loss_kind == "softmax" and \
+            bool(getattr(self.evaluator, "compute_confusion", False))
+
         gather = self._gather
 
         def train_batch(data_args, carry, batch_in):
@@ -190,6 +197,8 @@ class FusedTrainer(Logger):
 
         self._train_segment = _train_segment_call
 
+        wants_confusion = self.wants_confusion
+
         def eval_segment_pure(data_args, params_list, idx_matrix):
             def body(_, idx):
                 x, truth = gather(data_args, idx)
@@ -197,9 +206,15 @@ class FusedTrainer(Logger):
                 out = self._forward(params_list, x, None, train=False)
                 _, report, metric = self._loss_and_metrics(out, truth,
                                                            valid)
+                if wants_confusion:
+                    conf = self._batch_confusion(out, truth, valid)
+                    return None, (report, metric, conf)
                 return None, (report, metric)
-            _, (losses, metrics) = jax.lax.scan(body, None, idx_matrix)
-            return losses, metrics
+            _, outs = jax.lax.scan(body, None, idx_matrix)
+            if wants_confusion:
+                losses, metrics, confs = outs
+                return losses, metrics, jnp.sum(confs, axis=0)
+            return outs
 
         jit_eval = self._compile_eval(eval_segment_pure)
 
@@ -208,11 +223,24 @@ class FusedTrainer(Logger):
 
         self._eval_segment = _eval_segment_call
 
+    @staticmethod
+    def _batch_confusion(out, truth, valid):
+        """One minibatch's confusion counts (eager: evaluator.py:39-42)."""
+        probs = out.reshape(out.shape[0], -1)
+        n_classes = probs.shape[-1]
+        pred = jnp.argmax(probs, axis=1)
+        safe = jnp.where(valid, truth, 0)
+        flat = safe * n_classes + pred
+        return jnp.zeros((n_classes * n_classes,), jnp.int32).at[
+            flat].add(valid.astype(jnp.int32)).reshape(
+            n_classes, n_classes)
+
     def confusion_segment(self, params_list, idx_matrix):
         """Summed confusion matrix of a forward pass over a segment.
 
-        Lazily compiled; only the fused production runner asks for it
-        (when a confusion plotter hangs off the graph). Whole-segment
+        Lazily compiled, and only needed for the TRAIN class when no
+        validation set exists — eval segments already return confusion
+        alongside losses when ``wants_confusion``. Whole-segment
         accumulation supersedes the eager evaluator's last-minibatch
         snapshot of ``confusion_matrix``."""
         if self.loss_kind != "softmax":
@@ -224,19 +252,43 @@ class FusedTrainer(Logger):
                     x, truth = self._gather(data_args, idx)
                     valid = idx >= 0
                     out = self._forward(params_list, x, None, train=False)
-                    probs = out.reshape(out.shape[0], -1)
-                    n_classes = probs.shape[-1]
-                    pred = jnp.argmax(probs, axis=1)
-                    safe = jnp.where(valid, truth, 0)
-                    flat = safe * n_classes + pred
-                    conf = jnp.zeros((n_classes * n_classes,),
-                                     jnp.int32).at[flat].add(
-                        valid.astype(jnp.int32))
-                    return None, conf.reshape(n_classes, n_classes)
+                    return None, self._batch_confusion(out, truth, valid)
                 _, confs = jax.lax.scan(body, None, idx_matrix)
                 return jnp.sum(confs, axis=0)
             fn = self._conf_fn = jax.jit(conf_pure)
         return fn(self._data_args, params_list, jnp.asarray(idx_matrix))
+
+    def _dropout_base_key(self):
+        """Per-epoch dropout key, drawn from the DROPOUT unit's stream
+        (eager: DropoutForward._draw_mask uses prng.get(self.rand_name),
+        nn/base.py:39) — never from the loader's, whose shuffle sequence
+        must stay bit-identical to an eager run of the same seed."""
+        for fwd in self.forwards:
+            if isinstance(fwd, DropoutForward):
+                return prng.get(fwd.rand_name).jax_key()
+        # keys are dead in the trace without dropout; a constant keeps
+        # every stream untouched
+        return jax.random.PRNGKey(0)
+
+    # -- class-level driving (shared by run_epoch and FusedRunner) ---------
+
+    def eval_class(self, params, klass):
+        """Forward-only sweep of one class.
+
+        Returns ``(losses, metrics, confusion)`` where ``confusion`` is
+        None unless it rides the eval scan (``wants_confusion``)."""
+        idx = self._segment_indices(klass)
+        out = self._eval_segment(params, jnp.asarray(idx))
+        return out[0], out[1], out[2] if len(out) == 3 else None
+
+    def train_class(self, params, states):
+        """One training sweep of the TRAIN class with per-batch dropout
+        keys folded from the epoch's base key."""
+        idx = self._segment_indices(TRAIN)
+        base = self._dropout_base_key()
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(idx.shape[0]))
+        return self._train_segment(params, states, jnp.asarray(idx), keys)
 
     # -- compilation hooks (overridden by parallel trainers) ---------------
     # signatures: train fn(data_args, params, states, idx, keys),
@@ -299,17 +351,14 @@ class FusedTrainer(Logger):
         for klass in (TEST, VALIDATION):
             if not self.loader.class_lengths[klass]:
                 continue
-            idx = self._segment_indices(klass)
-            losses, metrics = self._eval_segment(params, jnp.asarray(idx))
+            losses, metrics, conf = self.eval_class(params, klass)
+            if conf is not None:
+                self.evaluator.confusion_matrix = numpy.asarray(conf)
             stats[CLASS_NAMES[klass]] = self._summarize(
                 losses, metrics, klass)
         if self.loader.class_lengths[TRAIN]:
-            idx = self._segment_indices(TRAIN)
-            base = prng.get(self.loader.rand_name).jax_key()
-            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-                jnp.arange(idx.shape[0]))
-            params, states, losses, metrics = self._train_segment(
-                params, states, jnp.asarray(idx), keys)
+            params, states, losses, metrics = self.train_class(
+                params, states)
             stats[CLASS_NAMES[TRAIN]] = self._summarize(
                 losses, metrics, TRAIN)
             self.loader.epoch_number = epoch + 1
