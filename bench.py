@@ -1,28 +1,82 @@
 #!/usr/bin/env python3
-"""Benchmark: AlexNet training throughput, samples/sec/chip.
+"""Benchmark: AlexNet training throughput, samples/sec/chip + MFU.
 
 The driver-defined north star (BASELINE.json: "Znicz ImageNet-AlexNet
 samples/sec/chip"). Trains the full AlexNet stack (227x227x3, 1000
 classes, conv+LRN+pool+fc+dropout+softmax) on synthetic ImageNet-shaped
 data with the fused step compiler on one TPU chip and reports
-steady-state training throughput (compile excluded).
+steady-state training throughput (compile excluded) over a >=30 s
+timed window, plus roofline accounting: analytic model TFLOP/s against
+the chip's measured large-matmul rate (MFU).
 
 vs_baseline: the reference ships no samples/sec table
 (BASELINE.json.published == {}); 500 img/s is the documented
 2015-era single-GPU AlexNet training throughput (cuDNN-class hardware
 the reference's CUDA backend targeted), used as the denominator.
 
-Prints exactly ONE JSON line on stdout.
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
 """
 
 import json
 import logging
+import os
 import sys
 import time
 
 logging.disable(logging.WARNING)
 
 BASELINE_SAMPLES_PER_SEC = 500.0
+MIN_TIMED_WINDOW_S = 30.0
+#: compute policy for the headline number (a first-class framework
+#: capability: --precision on the CLI; f32 params + f32 accumulation,
+#: bf16 activations between layers — see veles_tpu/nn/precision.py)
+PRECISION = os.environ.get("VELES_BENCH_PRECISION", "bfloat16")
+
+
+def model_train_flops_per_sample(wf):
+    """Analytic FLOPs to train ONE sample: 3x the forward matmul/conv
+    FLOPs (forward + grad-input + grad-weights passes), the standard
+    accounting (e.g. the scaling-book convention). Elementwise ops
+    (LRN, pooling, dropout, activations) are excluded — they are
+    bandwidth, not FLOPs."""
+    total = 0.0
+    for fwd in wf.forwards:
+        name = type(fwd).__name__
+        in_shape = tuple(fwd.input.shape)
+        out_shape = tuple(fwd.output.shape)
+        if name.startswith("Conv"):
+            ky, kx, cin, cout = fwd.weights.shape
+            out_hw = out_shape[1] * out_shape[2]
+            total += 2.0 * out_hw * ky * kx * cin * cout * 3.0
+        elif name.startswith("All2All"):
+            fin, fout = fwd.weights.shape
+            total += 2.0 * fin * fout * 3.0
+        # pooling/LRN/dropout: no matmul FLOPs
+        del in_shape
+    return total
+
+
+def measured_matmul_peak_tflops():
+    """Sustained large-matmul rate of THIS chip (the roofline's compute
+    ceiling): a 50-long chain of 8192^2 f32 matmuls inside one jit (on
+    TPU, f32 dot runs the MXU's native bf16-pass path by default, so
+    this is the relevant ceiling for either precision policy)."""
+    import jax
+    import jax.numpy as jnp
+
+    n, iters = 8192, 50
+    a = jnp.ones((n, n), jnp.float32)
+
+    def body(x, _):
+        return (x @ a) * (1.0 / n), None
+
+    f = jax.jit(lambda a0: jax.lax.scan(body, a0, None,
+                                        length=iters)[0].sum())
+    float(f(a))  # compile + warm
+    t = time.time()
+    float(f(a))
+    dt = time.time() - t
+    return 2.0 * n ** 3 * iters / dt / 1e12
 
 
 def main():
@@ -35,8 +89,10 @@ def main():
     from veles_tpu.models.alexnet import (ALEXNET_LAYERS,
                                           AlexNetWorkflow,
                                           SyntheticImageLoader)
+    from veles_tpu.nn.precision import set_policy
     from veles_tpu.train import FusedTrainer
 
+    set_policy(PRECISION)
     batch = 128
     n_train = 1024
     prng.get().seed(42)
@@ -67,25 +123,47 @@ def main():
     print("warmup (compile + settle): %.1fs" % (time.time() - t_compile),
           file=sys.stderr)
 
-    # steady state: time full training epochs; the float() read forces
-    # the whole on-device chain (block_until_ready alone can return
-    # early through the remote-execution relay)
-    epochs = 5
+    # steady state: full training epochs until the window is >=30 s.
+    # One forcing read per chunk (float() pulls the scalar through the
+    # remote-execution relay; block_until_ready alone can return early)
+    # — 20 epochs per chunk keeps the relay round-trips amortized.
+    chunk = 20
+    epochs = 0
     start = time.time()
-    for _ in range(epochs):
-        params, states, losses, _ = trainer._train_segment(
-            params, states, idx, keys)
-    final_loss = float(losses[-1])
-    elapsed = time.time() - start
-    print("final loss: %.4f" % final_loss, file=sys.stderr)
+    while True:
+        for _ in range(chunk):
+            params, states, losses, _ = trainer._train_segment(
+                params, states, idx, keys)
+        final_loss = float(losses[-1])
+        epochs += chunk
+        elapsed = time.time() - start
+        if elapsed >= MIN_TIMED_WINDOW_S:
+            break
+    print("final loss: %.4f  (policy=%s, %d epochs, %.1fs window)"
+          % (final_loss, PRECISION, epochs, elapsed), file=sys.stderr)
 
     samples_per_sec = epochs * n_train / elapsed
+
+    # roofline accounting
+    flops = model_train_flops_per_sample(wf)
+    eff_tflops = samples_per_sec * flops / 1e12
+    peak_tflops = measured_matmul_peak_tflops()
+    mfu = eff_tflops / peak_tflops
+    print("model: %.2f GFLOP/sample (trained)  effective: %.1f TFLOP/s  "
+          "chip matmul peak: %.1f TFLOP/s  MFU: %.1f%%"
+          % (flops / 1e9, eff_tflops, peak_tflops, mfu * 100),
+          file=sys.stderr)
+
     print(json.dumps({
         "metric": "alexnet_train_samples_per_sec_per_chip",
         "value": round(samples_per_sec, 1),
         "unit": "samples/s",
         "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC,
                              3),
+        "precision_policy": PRECISION,
+        "effective_tflops": round(eff_tflops, 1),
+        "matmul_peak_tflops": round(peak_tflops, 1),
+        "mfu_pct": round(mfu * 100, 1),
     }))
 
 

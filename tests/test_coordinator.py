@@ -195,8 +195,8 @@ def test_restore_rejects_out_of_bounds_refs():
     try:
         for off, size in ((0, 65), (-1, 4), (60, 8), (0, -1)):
             with pytest.raises(ConnectionError, match="bounds"):
-                Protocol._restore({"payload": {
-                    "__shm__": seg.name, "off": off, "size": size}})
+                Protocol._read_shm_ref({
+                    "__shm__": seg.name, "off": off, "size": size})
     finally:
         seg.close()
         seg.unlink()

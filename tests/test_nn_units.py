@@ -208,3 +208,85 @@ def test_kohonen_som_organizes():
     win = numpy.asarray(_winners(jnp.asarray(after), jnp.asarray(x)))
     qerr = numpy.linalg.norm(x - after[win], axis=1).mean()
     assert qerr < 0.3
+
+
+class TestPrecisionPolicy:
+    """bf16 mixed-precision policy (VERDICT r1 weak #8)."""
+
+    def teardown_method(self):
+        from veles_tpu.nn.precision import set_policy
+        set_policy(None)
+
+    def test_policies_resolve(self):
+        from veles_tpu.nn import precision
+        assert precision.get_policy().name == "float32"
+        precision.set_policy("bfloat16_mixed")
+        assert precision.get_policy().compute_dtype == jnp.bfloat16
+        assert precision.get_policy().accum_dtype == jnp.float32
+
+    def test_mixed_keeps_f32_boundaries_and_close_numerics(self):
+        import numpy as np
+        from veles_tpu.nn.precision import set_policy
+        from veles_tpu.nn.all2all import All2AllTanh
+        rng = np.random.RandomState(0)
+        params = {"weights": jnp.asarray(rng.rand(12, 8).astype("f") - .5),
+                  "bias": jnp.zeros((8,), "float32")}
+        x = jnp.asarray(rng.rand(4, 12).astype("f"))
+        unit = All2AllTanh.__new__(All2AllTanh)
+        unit.output_sample_shape = (8,)
+        unit.activation_name = "tanh"
+        y32 = unit.apply(params, x)
+        set_policy("bfloat16_mixed")
+        ymix = unit.apply(params, x)
+        assert ymix.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(ymix), np.asarray(y32),
+                                   atol=0.03)
+        set_policy("bfloat16")
+        yb = unit.apply(params, x)
+        assert yb.dtype == jnp.bfloat16
+
+    def test_conv_accum_dtype(self):
+        import numpy as np
+        from veles_tpu.nn.precision import set_policy
+        from veles_tpu.nn.conv import Conv
+        unit = Conv.__new__(Conv)
+        unit.n_kernels, unit.kx, unit.ky = 4, 3, 3
+        unit.sliding, unit.padding = (1, 1), "SAME"
+        unit.activation_name = "linear"
+        rng = np.random.RandomState(0)
+        params = {"weights": jnp.asarray(
+            rng.rand(3, 3, 2, 4).astype("f") - .5)}
+        x = jnp.asarray(rng.rand(2, 8, 8, 2).astype("f"))
+        y32 = unit.apply(params, x)
+        set_policy("bfloat16_mixed")
+        ymix = unit.apply(params, x)
+        assert ymix.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(ymix), np.asarray(y32),
+                                   atol=0.05)
+
+    def test_training_converges_under_mixed(self):
+        """A fused MNIST run under bf16_mixed reaches f32-class error."""
+        import sys
+        sys.path.insert(0, "tests")
+        from test_mnist_e2e import synthetic_digits
+        from veles_tpu import prng
+        from veles_tpu.backends import Device
+        from veles_tpu.dummy import DummyLauncher
+        from veles_tpu.models.mnist import MnistWorkflow
+        from veles_tpu.nn.precision import set_policy
+        from veles_tpu.train import FusedTrainer
+
+        def run(policy):
+            set_policy(policy)
+            prng.get().seed(42)
+            prng.get("loader").seed(43)
+            wf = MnistWorkflow(DummyLauncher(), provider=synthetic_digits(),
+                               layers=(32,), minibatch_size=60,
+                               learning_rate=0.08, max_epochs=4)
+            wf.initialize(device=Device(backend="cpu"))
+            history = FusedTrainer(wf).train()
+            return history[-1]["validation"]["normalized"]
+
+        err32 = run("float32")
+        errmix = run("bfloat16_mixed")
+        assert errmix <= err32 + 0.05

@@ -33,6 +33,7 @@ class Main(Logger):
         # import for the side effect of registering their CLI flags
         import veles_tpu.backends  # noqa: F401
         import veles_tpu.loader.base  # noqa: F401
+        import veles_tpu.nn.precision  # noqa: F401
         parser = cmdline.init_parser(
             prog="veles_tpu",
             description="TPU-native deep-learning workflow platform")
@@ -264,6 +265,9 @@ class Main(Logger):
             guess = os.path.splitext(self.args.workflow)[0] + "_config.py"
             self.args.config = guess if os.path.exists(guess) else None
 
+        if getattr(self.args, "precision", None):
+            from veles_tpu.nn.precision import set_policy
+            set_policy(self.args.precision)
         self._seed_random(self.args.seed)
         module = self._load_model(self.args.workflow)
         self._apply_config(self.args.config)

@@ -12,15 +12,18 @@ here is a plain object that:
 * owns the :class:`~veles_tpu.backends.Device` (masters do no compute,
   ``docs/source/manualrst_veles_distributed_training.rst:14``);
 * wires the workflow's IDistributable protocol onto the
-  :mod:`~veles_tpu.parallel.coordinator` control plane (jobs/updates are
-  pickled and base64-framed — the ZeroMQ streaming-pickle path of
-  ``txzmq/connection.py:483-516`` collapses to this);
+  :mod:`~veles_tpu.parallel.coordinator` control plane: payloads are
+  pickled, zlib-compressed cross-host, and ride the Protocol's binary
+  frames / same-host shm (:mod:`veles_tpu.parallel.wire` — the role of
+  the reference's txzmq streaming pickle + codecs,
+  ``txzmq/connection.py:140-143,283-339``);
+* farms out SEGMENT jobs (N minibatches through the slave's fused
+  step compiler per round-trip) whenever the workflow has the standard
+  trainable shape, single-minibatch jobs otherwise;
 * launches the graphics server and posts periodic status JSON to the
   web dashboard (``launcher.py:852-885``) when those services exist.
 """
 
-import base64
-import pickle
 import threading
 import time
 import uuid
@@ -28,14 +31,10 @@ import uuid
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
+from veles_tpu.parallel import wire
 
-
-def _encode(obj):
-    return base64.b64encode(pickle.dumps(obj, protocol=4)).decode("ascii")
-
-
-def _decode(blob):
-    return pickle.loads(base64.b64decode(blob.encode("ascii")))
+_encode = wire.encode
+_decode = wire.decode
 
 
 def parse_address(spec, default_host="0.0.0.0", default_port=5000):
@@ -59,7 +58,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         "listen_address", "master_address", "device", "backend", "testing",
         "stealth", "web_status", "graphics", "slave_death_probability",
         "job_timeout", "heartbeat_timeout", "max_idle",
-        "nodes", "respawn", "slave_command", "eager",
+        "nodes", "respawn", "slave_command", "eager", "segment_size",
+        "pipeline",
     ])
 
     def __init__(self, **kwargs):
@@ -86,6 +86,12 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.nodes = kwargs.get("nodes")
         self.respawn = kwargs.get("respawn", False)
         self.eager = kwargs.get("eager", False)
+        #: minibatches per distributed job (1 = reference-style);
+        #: segments amortize the round-trip + weight exchange
+        self.segment_size = kwargs.get("segment_size", 8)
+        #: slave: prefetch the next job while computing (async SGD,
+        #: one job of weight staleness); False = strict lockstep
+        self.pipeline = kwargs.get("pipeline", True)
         #: "fused" | "eager" once the standalone run path is chosen
         self.run_mode_used = None
         self.slave_command = kwargs.get("slave_command")
@@ -139,6 +145,16 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             help="run the eager per-unit scheduler instead of the fused "
                  "XLA step compiler (the default for standard-shaped "
                  "workflows)")
+        parser.add_argument(
+            "--segment-size", type=int, default=8,
+            help="minibatches per distributed job (master mode); 1 "
+                 "reproduces the reference's one-minibatch-per-job "
+                 "protocol")
+        parser.add_argument(
+            "--no-pipeline", dest="pipeline", action="store_false",
+            help="slave: strict request-reply instead of prefetching "
+                 "the next job while computing (exact sequential SGD, "
+                 "no overlap)")
         return parser
 
     # -- mode --------------------------------------------------------------
@@ -229,12 +245,26 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         # lift the initial stopped state by hand before serving jobs
         workflow.stopped = False
 
+        from veles_tpu.train.segment import segment_capable
+        segments = self.segment_size > 1 and segment_capable(workflow)
+        if segments:
+            self.info("serving fused segment jobs (%d minibatches each)",
+                      self.segment_size)
+
         def job_source(slave):
             try:
-                data = workflow.generate_data_for_slave(slave)
+                if segments:
+                    data = workflow.generate_segment_for_slave(
+                        slave, max_minibatches=self.segment_size)
+                else:
+                    data = workflow.generate_data_for_slave(slave)
             except NoMoreJobs:
                 raise NoMoreJobsError()
-            return {"blob": _encode(data)} if data is not None else None
+            if data is None:
+                return None
+            # same-host slaves get raw pickles through shm; remote
+            # slaves get zlib-compressed binary frames
+            return {"blob": _encode(data, compress=not slave.sharedio)}
 
         def result_sink(data, slave):
             workflow.apply_data_from_slave(_decode(data["blob"]), slave)
@@ -243,7 +273,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             workflow.drop_slave(slave)
 
         def initial_data_source(slave):
-            return _encode(workflow.generate_initial_data_for_slave(slave))
+            return _encode(workflow.generate_initial_data_for_slave(slave),
+                           compress=not slave.sharedio)
 
         self._server = CoordinatorServer(
             address=parse_address(self.listen_address),
@@ -277,7 +308,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             parse_address(self.master_address, default_host="127.0.0.1"),
             checksum=self.workflow.checksum,
             power=self.workflow.computing_power,
-            death_probability=self.slave_death_probability)
+            death_probability=self.slave_death_probability,
+            pipeline=self.pipeline)
         self._client.connect()
         self.info("connected to master as slave %s", self._client.id)
         if self._client.initial_data is not None:
@@ -366,15 +398,17 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
 
     def _run_slave(self):
         workflow = self.workflow
+        from veles_tpu.train.segment import SegmentExecutor
+        executor = SegmentExecutor(workflow, eager=self.eager)
+        compress = not self._client.proto._shm_tx
 
         def handler(job):
-            update = [None]
-
-            def callback(data):
-                update[0] = data
-
-            workflow.do_job(_decode(job["blob"]), callback=callback)
-            return {"blob": _encode(update[0])}
+            payload = _decode(job["blob"])
+            if isinstance(payload, dict) and "batches" in payload:
+                update = executor.execute(payload)
+            else:
+                update = workflow.do_job(payload)
+            return {"blob": _encode(update, compress=compress)}
 
         self._client.serve_forever(handler, max_idle=self.max_idle)
 

@@ -274,7 +274,9 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
     def apply_data_from_slave(self, data, slave=None):
         sid = getattr(slave, "id", slave)
         pending = self._pending_.get(sid)
-        if pending:
+        # a segment update resolves several served minibatches at once
+        count = (data or {}).get("count", 1)
+        for _ in range(min(count, len(pending or ()))):
             pending.pop(0)
 
     def drop_slave(self, slave=None):

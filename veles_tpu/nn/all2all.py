@@ -11,6 +11,7 @@ import numpy
 
 from veles_tpu.nn.activation import get_activation
 from veles_tpu.nn.base import ForwardBase
+from veles_tpu.nn.precision import get_policy
 
 
 class All2All(ForwardBase):
@@ -45,11 +46,15 @@ class All2All(ForwardBase):
 
     def apply(self, params, x):
         batch = x.shape[0]
-        y = jnp.dot(x.reshape(batch, -1), params["weights"],
-                    preferred_element_type=jnp.float32)
+        pol = get_policy()
+        xc, wc = pol.cast_in(x.reshape(batch, -1), params["weights"])
+        # preferred_element_type keeps the MXU's f32 accumulator all
+        # the way to the output (uniform operand dtypes, so the dot vjp
+        # accepts it — unlike conv's)
+        y = jnp.dot(xc, wc, preferred_element_type=pol.accum_dtype)
         if "bias" in params:
             y = y + params["bias"]
-        y = get_activation(self.activation_name)(y)
+        y = pol.cast_out(get_activation(self.activation_name)(y))
         return y.reshape((batch,) + self.output_sample_shape)
 
 
@@ -75,12 +80,20 @@ class All2AllSoftmax(All2All):
 
     ACTIVATION = "linear"
 
-    def apply(self, params, x):
+    def _logits(self, params, x):
+        """Head logits, always float32 (softmax/CE numerics need it
+        regardless of the compute policy)."""
         batch = x.shape[0]
-        logits = jnp.dot(x.reshape(batch, -1), params["weights"],
-                         preferred_element_type=jnp.float32)
+        pol = get_policy()
+        xc, wc = pol.cast_in(x.reshape(batch, -1), params["weights"])
+        logits = jnp.dot(xc, wc, preferred_element_type=jnp.float32)
         if "bias" in params:
             logits = logits + params["bias"]
+        return logits
+
+    def apply(self, params, x):
+        batch = x.shape[0]
+        logits = self._logits(params, x)
         # max-subtracted for stability, matches reference's softmax kernel
         z = logits - jnp.max(logits, axis=1, keepdims=True)
         e = jnp.exp(z)
@@ -92,8 +105,5 @@ class All2AllSoftmax(All2All):
         gradient w.r.t. logits (softmax+CE fused), so GDSoftmax must not
         differentiate through the softmax again."""
         batch = x.shape[0]
-        logits = jnp.dot(x.reshape(batch, -1), params["weights"],
-                         preferred_element_type=jnp.float32)
-        if "bias" in params:
-            logits = logits + params["bias"]
-        return logits.reshape((batch,) + self.output_sample_shape)
+        return self._logits(params, x).reshape(
+            (batch,) + self.output_sample_shape)

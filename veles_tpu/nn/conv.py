@@ -12,6 +12,7 @@ import numpy
 
 from veles_tpu.nn.activation import get_activation
 from veles_tpu.nn.base import ForwardBase
+from veles_tpu.nn.precision import get_policy
 
 
 class Conv(ForwardBase):
@@ -68,15 +69,23 @@ class Conv(ForwardBase):
     def apply(self, params, x):
         if x.ndim == 3:
             x = x[..., None]  # grayscale -> NHWC
+        pol = get_policy()
+        xc, wc = pol.cast_in(x, params["weights"])
+        # no preferred_element_type: lax.conv's vjp rejects the widened
+        # output dtype (cotangent f32 vs bf16 operands — unlike dot's).
+        # The MXU still accumulates f32 internally; a narrow policy's
+        # output pays ONE bf16 rounding at the conv boundary before the
+        # upcast — the same magnitude of rounding the policy already
+        # accepts at every cast_in
         y = jax.lax.conv_general_dilated(
-            x.astype(jnp.float32), params["weights"].astype(jnp.float32),
+            xc, wc,
             window_strides=(self.sliding[1], self.sliding[0]),
             padding=self._pad_pairs(),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = y.astype(pol.accum_dtype)
         if "bias" in params:
             y = y + params["bias"]
-        return get_activation(self.activation_name)(y)
+        return pol.cast_out(get_activation(self.activation_name)(y))
 
 
 class ConvTanh(Conv):
@@ -127,13 +136,16 @@ class Deconv(ForwardBase):
     def apply(self, params, x):
         if x.ndim == 3:
             x = x[..., None]
+        pol = get_policy()
+        xc, wc = pol.cast_in(x, params["weights"])
         y = jax.lax.conv_transpose(
-            x.astype(jnp.float32), params["weights"].astype(jnp.float32),
+            xc, wc,
             strides=(self.sliding[1], self.sliding[0]),
             padding=self.padding if isinstance(self.padding, str)
             else [(p, p) for p in (self.padding, self.padding)]
             if isinstance(self.padding, int) else self.padding,
             dimension_numbers=("NHWC", "HWOI", "NHWC"))
+        y = y.astype(pol.accum_dtype)
         if "bias" in params:
             y = y + params["bias"]
-        return y
+        return pol.cast_out(y)

@@ -121,6 +121,19 @@ class DecisionBase(Unit, IResultProvider):
         """
         if data is None:
             return
+        if isinstance(data, list):
+            # a fused-segment update: one stats dict per minibatch
+            for item in data:
+                self.apply_data_from_slave(item, slave)
+            return
+        stop_epoch = getattr(self, "_stop_epoch_", None)
+        if stop_epoch is not None and data.get("epoch", 0) > stop_epoch:
+            # run-ahead: pipelined/segmented slaves may return
+            # minibatches of epochs past the stop decision — they must
+            # not reopen buckets or extend epoch_history (laggard
+            # updates for epochs <= the stop epoch still close
+            # normally)
+            return
         buckets = getattr(self, "_epoch_buckets_", None)
         if buckets is None:
             buckets = self._epoch_buckets_ = {}
@@ -206,6 +219,12 @@ class DecisionBase(Unit, IResultProvider):
             stop = True
         if stop:
             self.complete <<= True
+            self._stop_epoch_ = epoch
+            # discard run-ahead buckets of epochs the stop cancels
+            buckets = getattr(self, "_epoch_buckets_", None)
+            if buckets:
+                for run_ahead in [e for e in buckets if e > epoch]:
+                    buckets.pop(run_ahead)
         self._reset_epoch()
 
     def get_metric_values(self):

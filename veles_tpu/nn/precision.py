@@ -1,0 +1,98 @@
+"""Mixed-precision policy for the NN compute path.
+
+The reference exposed ``--precision-level`` 0/1/2 to trade GEMM speed
+against summation accuracy on GPUs (``veles/config.py``,
+``ocl/gemm.cl``); on TPU the equivalent lever points the other way:
+the MXU natively multiplies bfloat16 with float32 accumulation, so the
+policy here selects the COMPUTE dtype while parameters and accumulation
+stay float32 — the standard TPU mixed-precision recipe.
+
+Policies (select with ``--precision`` / ``VELES_PRECISION`` /
+``root.common.engine.precision``):
+
+* ``float32``        — everything f32 (default; bit-stable baseline);
+* ``bfloat16_mixed`` — activations/weights cast to bf16 at each
+  matmul/conv, accumulation and stored parameters f32. Halves the HBM
+  traffic of the bandwidth-bound layers and engages the MXU's native
+  bf16 path; solver updates still see f32 gradients (the cast's vjp
+  casts back);
+* ``bfloat16``       — activations stay bf16 between layers too (most
+  aggressive; evaluator losses still reduce in f32).
+
+The policy is read at TRACE time: changing it invalidates jit caches
+naturally (the dtypes in the traced program change), no manual flush
+needed — but a FusedTrainer built under one policy keeps it for its
+lifetime, matching how the reference pinned precision per run.
+"""
+
+import os
+
+import jax.numpy as jnp
+
+from veles_tpu.cmdline import CommandLineArgumentsRegistry
+from veles_tpu.config import root
+
+
+class Policy(object):
+    """(compute, accum, keep) dtypes: inputs cast to ``compute``,
+    matmul/conv accumulate in ``accum``, layer outputs cast to
+    ``keep`` (None = leave at accum dtype)."""
+
+    def __init__(self, name, compute, accum, keep):
+        self.name = name
+        self.compute_dtype = compute
+        self.accum_dtype = accum
+        self.keep_dtype = keep
+
+    def cast_in(self, *arrays):
+        """Cast matmul/conv operands to the compute dtype."""
+        out = tuple(a.astype(self.compute_dtype) if a is not None else None
+                    for a in arrays)
+        return out if len(out) > 1 else out[0]
+
+    def cast_out(self, y):
+        """Dtype a layer hands to the NEXT layer."""
+        if self.keep_dtype is not None and y.dtype != self.keep_dtype:
+            return y.astype(self.keep_dtype)
+        return y
+
+
+POLICIES = {
+    "float32": Policy("float32", jnp.float32, jnp.float32, jnp.float32),
+    "bfloat16_mixed": Policy("bfloat16_mixed", jnp.bfloat16, jnp.float32,
+                             jnp.float32),
+    "bfloat16": Policy("bfloat16", jnp.bfloat16, jnp.float32,
+                       jnp.bfloat16),
+}
+
+_forced = None
+
+
+def get_policy():
+    """Resolve the active policy: explicit ``set_policy`` > env var >
+    config tree > float32."""
+    if _forced is not None:
+        return _forced
+    name = os.environ.get("VELES_PRECISION") or \
+        root.common.engine.get("precision", "float32")
+    try:
+        return POLICIES[name]
+    except KeyError:
+        raise ValueError("unknown precision policy %r (have %s)" %
+                         (name, sorted(POLICIES)))
+
+
+def set_policy(name):
+    """Pin the process-wide policy (None = back to config/env)."""
+    global _forced
+    _forced = None if name is None else POLICIES[name]
+
+
+class _Args(metaclass=CommandLineArgumentsRegistry):
+    @staticmethod
+    def init_parser(parser, **kwargs):
+        parser.add_argument(
+            "--precision", default=None, choices=sorted(POLICIES),
+            help="NN compute precision policy (default float32; "
+                 "bfloat16_mixed = bf16 MXU math, f32 params/accum)")
+        return parser

@@ -39,37 +39,52 @@ class NoMoreJobsError(Exception):
 
 
 class Protocol(object):
-    """JSON-lines framing over a socket, with an optional same-host
-    shared-memory fast path.
+    """JSON control line + length-prefixed binary frames, with an
+    optional same-host shared-memory fast path.
+
+    ``bytes`` values anywhere in a message ride AFTER the JSON line as
+    raw frames (8-byte big-endian length prefix) — the reference's
+    txzmq streamed pickles the same way (``txzmq/connection.py:283-339``)
+    instead of inflating them 33% through base64. The JSON line carries
+    ``{"__bin__": i}`` placeholders in traversal order.
 
     When both peers share a machine (``enable_sharedio()`` after the
-    handshake's machine-id comparison), large ``"blob"`` payloads go
+    handshake's nonce-proven same-host check), large payloads go
     through ONE sender-owned ``multiprocessing.shared_memory`` segment
-    — the socket carries only ``{"__shm__": name, "size": n}``. The
-    segment is reused across messages and regrown on demand: the
+    — the socket carries only ``{"__shm__": name, "off": o, "size": n}``.
+    The segment is reused across messages and regrown on demand: the
     re-design of the reference's ``txzmq/sharedio.py:44-106`` + the
-    IOOverflow regrow (``server.py:156-167``). Safe because the
-    protocol is strict request↔reply per connection, so a segment is
-    never written while the peer still reads it.
+    IOOverflow regrow (``server.py:156-167``). Safe because a segment
+    is never rewritten while the peer still reads it (request↔reply,
+    or the bounded-pipeline discipline of the slave protocol where the
+    reply to the message that carried a ref arrives before reuse).
     """
 
     #: blobs below this stay inline (shm setup isn't free)
     SHM_THRESHOLD = 64 * 1024
+    #: refuse binary frames beyond this (hostile length prefix)
+    MAX_FRAME = 1 << 31
 
     def __init__(self, sock):
         self.sock = sock
         self._file = sock.makefile("rwb")
         self._wlock = threading.Lock()
+        self._rlock = threading.Lock()
         self._shm_tx = False
         self._shm_rx = False
-        self._segment = None
+        # double-buffered: with the pipelined slave protocol up to TWO
+        # of this sender's messages can be unread at the peer, so
+        # consecutive sends must not share a segment (send i+2 reuses
+        # send i's slot, which the bounded pipeline guarantees is read)
+        self._segments = [None, None]
+        self._seg_turn = 0
         self.shm_sends = 0
         self.shm_reads = 0
 
     # -- sharedio ----------------------------------------------------------
 
     def enable_sharedio(self):
-        """Opt in after the handshake's machine-id comparison. Both
+        """Opt in after the handshake's same-host proof. Both
         directions: sending offloads blobs, and receiving will
         dereference ``__shm__`` refs — a protocol that never enabled
         sharedio (remote peer, feed sockets) treats such refs as plain
@@ -80,117 +95,147 @@ class Protocol(object):
 
     def _segment_for(self, size):
         from multiprocessing import shared_memory
-        if self._segment is not None and self._segment.size >= size:
-            return self._segment
-        if self._segment is not None:  # regrow
-            self._segment.close()
-            self._segment.unlink()
-        self._segment = shared_memory.SharedMemory(
+        turn = self._seg_turn
+        self._seg_turn = (turn + 1) % len(self._segments)
+        seg = self._segments[turn]
+        if seg is not None and seg.size >= size:
+            return seg
+        if seg is not None:  # regrow
+            seg.close()
+            seg.unlink()
+        seg = shared_memory.SharedMemory(
             create=True, size=max(size, self.SHM_THRESHOLD))
-        return self._segment
+        self._segments[turn] = seg
+        return seg
 
-    def _collect_blobs(self, message, found):
-        """Gather offload-eligible blob paths (two-pass: the segment
-        must be sized for ALL of a message's blobs before writing —
-        one blob per message is the common case, but a regrow between
-        writes would unlink bytes an earlier ref still points to)."""
-        for key, value in message.items():
-            if key == "blob" and isinstance(value, str) \
-                    and len(value) >= self.SHM_THRESHOLD:
-                found.append((message, key, value.encode("utf-8")))
-            elif isinstance(value, dict):
-                self._collect_blobs(value, found)
+    # -- send path ---------------------------------------------------------
 
-    def _offload(self, message):
-        if not isinstance(message, dict):
-            return message
-        import copy
-        message = copy.deepcopy(message)
-        found = []
-        self._collect_blobs(message, found)
-        if not found:
-            return message
-        seg = self._segment_for(sum(len(data) for _, _, data in found))
-        offset = 0
-        for container, key, data in found:
-            seg.buf[offset:offset + len(data)] = data
-            container[key] = {"__shm__": seg.name, "off": offset,
-                              "size": len(data)}
-            offset += len(data)
-            self.shm_sends += 1
-        return message
-
-    @classmethod
-    def _restore(cls, message):
-        if not isinstance(message, dict):
-            return message
-        out = {}
-        for key, value in message.items():
-            if isinstance(value, dict) and "__shm__" in value:
-                from multiprocessing import shared_memory
-                try:
-                    seg = shared_memory.SharedMemory(name=value["__shm__"])
-                except (OSError, ValueError) as e:
-                    raise ConnectionError("stale sharedio ref: %s" % e)
-                try:
-                    # CPython's SharedMemory registers every attach with
-                    # THIS process's resource tracker, which would
-                    # unlink the sender's live segment when we exit —
-                    # deregister: the sender owns the segment
-                    from multiprocessing import resource_tracker
-                    resource_tracker.unregister(seg._name, "shared_memory")
-                except Exception:
-                    pass
-                try:
-                    off = int(value.get("off", 0))
-                    size = int(value["size"])
-                    if off < 0 or size < 0 or off + size > seg.size:
-                        # stale ref after a regrow, or a hostile peer:
-                        # a silent slice-truncation would hand a corrupt
-                        # blob to the decoder instead of failing here
-                        raise ConnectionError(
-                            "sharedio ref out of bounds: off=%d size=%d "
-                            "segment=%d" % (off, size, seg.size))
-                    out[key] = bytes(
-                        seg.buf[off:off + size]).decode("utf-8")
-                finally:
-                    seg.close()  # sender owns the segment; never unlink
-            elif isinstance(value, dict):
-                out[key] = cls._restore(value)
-            else:
-                out[key] = value
-        return out
-
-    # -- framing -----------------------------------------------------------
+    def _pack(self, value, bins, shm_items):
+        """Transform a message for the wire: bytes → binary-frame or
+        shm markers; legacy big-str ``"blob"`` values → shm (utf-8).
+        shm candidates are only *collected* here (two-pass: the segment
+        must be sized for ALL of a message's blobs before writing — a
+        regrow between writes would unlink bytes an earlier ref still
+        points to); the caller fills the placeholder dicts after."""
+        if isinstance(value, bytes):
+            if self._shm_tx and len(value) >= self.SHM_THRESHOLD:
+                ref = {}
+                shm_items.append((ref, value, "b"))
+                return ref
+            bins.append(value)
+            return {"__bin__": len(bins) - 1}
+        if isinstance(value, dict):
+            out = {}
+            for key, item in value.items():
+                if key == "blob" and isinstance(item, str) and \
+                        self._shm_tx and len(item) >= self.SHM_THRESHOLD:
+                    ref = {}
+                    shm_items.append((ref, item.encode("utf-8"), "s"))
+                    out[key] = ref
+                else:
+                    out[key] = self._pack(item, bins, shm_items)
+            return out
+        if isinstance(value, (list, tuple)):
+            return [self._pack(item, bins, shm_items) for item in value]
+        return value
 
     def send(self, message):
-        # offload under the write lock: the shared segment must not be
-        # overwritten while a previous ref is still in flight
+        # pack + write under the write lock: the shared segment must not
+        # be overwritten while a previous ref is still in flight
         with self._wlock:
-            if self._shm_tx:
-                message = self._offload(message)
+            bins = []
+            shm_items = []
+            message = self._pack(message, bins, shm_items)
+            if shm_items:
+                seg = self._segment_for(
+                    sum(len(data) for _, data, _ in shm_items))
+                offset = 0
+                for ref, data, kind in shm_items:
+                    seg.buf[offset:offset + len(data)] = data
+                    ref.update({"__shm__": seg.name, "off": offset,
+                                "size": len(data), "kind": kind})
+                    offset += len(data)
+                    self.shm_sends += 1
             self._file.write((json.dumps(message) + "\n").encode())
+            for data in bins:
+                self._file.write(len(data).to_bytes(8, "big"))
+                self._file.write(data)
             self._file.flush()
 
-    def recv(self):
-        line = self._file.readline()
-        if not line:
-            raise ConnectionError("peer closed")
-        message = json.loads(line)
-        if self._shm_rx and self._has_shm_ref(message):
-            self.shm_reads += 1
-            return self._restore(message)
-        return message
+    # -- receive path ------------------------------------------------------
+
+    def _read_exact(self, n):
+        data = self._file.read(n)
+        if data is None or len(data) != n:
+            raise ConnectionError("peer closed mid-frame")
+        return data
 
     @classmethod
-    def _has_shm_ref(cls, message):
-        if not isinstance(message, dict):
-            return False
-        for value in message.values():
-            if isinstance(value, dict):
-                if "__shm__" in value or cls._has_shm_ref(value):
-                    return True
-        return False
+    def _count_bins(cls, value):
+        if isinstance(value, dict):
+            if "__bin__" in value and len(value) == 1:
+                return 1
+            return sum(cls._count_bins(v) for v in value.values())
+        if isinstance(value, list):
+            return sum(cls._count_bins(v) for v in value)
+        return 0
+
+    def _unpack(self, value, bins):
+        if isinstance(value, dict):
+            if "__bin__" in value and len(value) == 1:
+                return bins[value["__bin__"]]
+            if "__shm__" in value and self._shm_rx:
+                self.shm_reads += 1
+                return self._read_shm_ref(value)
+            return {k: self._unpack(v, bins) for k, v in value.items()}
+        if isinstance(value, list):
+            return [self._unpack(v, bins) for v in value]
+        return value
+
+    @staticmethod
+    def _read_shm_ref(value):
+        from multiprocessing import shared_memory
+        try:
+            seg = shared_memory.SharedMemory(name=value["__shm__"])
+        except (OSError, ValueError) as e:
+            raise ConnectionError("stale sharedio ref: %s" % e)
+        try:
+            # CPython's SharedMemory registers every attach with THIS
+            # process's resource tracker, which would unlink the
+            # sender's live segment when we exit — deregister: the
+            # sender owns the segment
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(seg._name, "shared_memory")
+        except Exception:
+            pass
+        try:
+            off = int(value.get("off", 0))
+            size = int(value["size"])
+            if off < 0 or size < 0 or off + size > seg.size:
+                # stale ref after a regrow, or a hostile peer: a silent
+                # slice-truncation would hand a corrupt blob to the
+                # decoder instead of failing here
+                raise ConnectionError(
+                    "sharedio ref out of bounds: off=%d size=%d "
+                    "segment=%d" % (off, size, seg.size))
+            raw = bytes(seg.buf[off:off + size])
+        finally:
+            seg.close()  # sender owns the segment; never unlink
+        return raw.decode("utf-8") if value.get("kind") == "s" else raw
+
+    def recv(self):
+        with self._rlock:
+            line = self._file.readline()
+            if not line:
+                raise ConnectionError("peer closed")
+            message = json.loads(line)
+            bins = []
+            for _ in range(self._count_bins(message)):
+                n = int.from_bytes(self._read_exact(8), "big")
+                if n > self.MAX_FRAME:
+                    raise ConnectionError("oversized frame (%d)" % n)
+                bins.append(self._read_exact(n))
+        return self._unpack(message, bins)
 
     def close(self):
         try:
@@ -198,13 +243,14 @@ class Protocol(object):
             self.sock.close()
         except OSError:
             pass
-        if self._segment is not None:
-            try:
-                self._segment.close()
-                self._segment.unlink()
-            except (OSError, FileNotFoundError):
-                pass
-            self._segment = None
+        for i, seg in enumerate(self._segments):
+            if seg is not None:
+                try:
+                    seg.close()
+                    seg.unlink()
+                except (OSError, FileNotFoundError):
+                    pass
+                self._segments[i] = None
 
 
 def _prove_same_host(proto):
@@ -287,14 +333,30 @@ class SlaveDescription(object):
         self.state = "WAIT"
         self.jobs_done = 0
         self.last_seen = time.time()
-        self.current_job = None
+        #: jobs handed out and not yet resolved, oldest first — the
+        #: pipelined slave protocol keeps up to MAX_IN_FLIGHT open
+        #: (the reference's balance counter, ``server.py:377-398``)
+        self.jobs_in_flight = []
+        #: proven same-host (payload codec decisions read this)
+        self.sharedio = False
         # True while result_sink is merging this slave's update: the
         # reaper must not drop/requeue mid-merge (double training)
         self.applying = False
 
+    @property
+    def current_job(self):
+        return self.jobs_in_flight[0] if self.jobs_in_flight else None
+
 
 class CoordinatorServer(Logger):
-    """Master: accepts slaves, verifies checksum, farms jobs out."""
+    """Master: accepts slaves, verifies checksum, farms jobs out.
+
+    A slave may hold up to :attr:`MAX_IN_FLIGHT` unresolved jobs — the
+    async pipelining of the reference (``client.py:433-437`` overlaps
+    the update upload with the next job fetch; the server's balance
+    counter ``server.py:377-398`` bounds the run-ahead)."""
+
+    MAX_IN_FLIGHT = 2
 
     def __init__(self, address=("127.0.0.1", 0), checksum="",
                  job_timeout=None, heartbeat_timeout=10.0,
@@ -393,11 +455,13 @@ class CoordinatorServer(Logger):
     def drop_slave(self, sid):
         slave = self.slaves.pop(sid, None)
         if slave is not None:
-            if slave.current_job is not None:
+            if slave.jobs_in_flight:
                 if self.on_drop is None:
-                    # static job farming: requeue the raw payload
-                    self.jobs.insert(0, slave.current_job[0])
-                slave.current_job = None
+                    # static job farming: requeue the raw payloads
+                    # (oldest first keeps the original order)
+                    for payload, _ in reversed(slave.jobs_in_flight):
+                        self.jobs.insert(0, payload)
+                slave.jobs_in_flight = []
             if self.on_drop is not None:
                 # dynamic mode: the workflow owns requeueing (e.g. the
                 # Loader moves pending minibatches to failed_minibatches
@@ -454,6 +518,7 @@ class CoordinatorServer(Logger):
                 sharedio = _prove_same_host(proto)
             if sharedio:
                 proto.enable_sharedio()
+            slave_desc.sharedio = sharedio
             reply = {"id": sid, "log_id": sid, "sharedio": sharedio,
                      "mid": hex(uuid.getnode())}
             if self.initial_data_source is not None:
@@ -491,22 +556,37 @@ class CoordinatorServer(Logger):
                 return {"error": "dropped"}, True
             slave.last_seen = time.time()
             if cmd == "job":
+                if len(slave.jobs_in_flight) >= self.MAX_IN_FLIGHT:
+                    # run-ahead bound: the pipeline may keep at most
+                    # MAX_IN_FLIGHT jobs open (balance counter parity)
+                    return {"job": None, "done": False,
+                            "backoff": True}, False
                 if self.jobs:
                     payload = self.jobs.pop(0)
-                    slave.current_job = (payload, time.time())
+                    slave.jobs_in_flight.append((payload, time.time()))
                     slave.state = "WORK"
                     return {"job": payload}, False
                 if self.job_source is None or self.no_more_jobs:
-                    slave.state = "IDLE"
+                    if not slave.jobs_in_flight:
+                        slave.state = "IDLE"
                     return {"job": None, "done": self.no_more_jobs}, False
                 action = "source"
             elif cmd == "result":
-                if slave.current_job is not None:
-                    self.job_times.append(
-                        time.time() - slave.current_job[1])
-                slave.current_job = None
+                if slave.jobs_in_flight:
+                    # results resolve oldest-first (replies are ordered
+                    # per connection, so this matches the slave's view)
+                    payload, started = slave.jobs_in_flight.pop(0)
+                    self.job_times.append(time.time() - started)
+                    if slave.jobs_in_flight:
+                        # the prefetched job only STARTS computing now:
+                        # restart its clock so the adaptive timeout and
+                        # job_times measure compute, not pipeline wait
+                        nxt_payload, _ = slave.jobs_in_flight[0]
+                        slave.jobs_in_flight[0] = (nxt_payload,
+                                                   time.time())
                 slave.jobs_done += 1
-                slave.state = "WAIT"
+                if not slave.jobs_in_flight:
+                    slave.state = "WAIT"
                 if self.result_sink is None:
                     self.results.append(msg.get("data"))
                     return {"ok": True}, False
@@ -534,10 +614,11 @@ class CoordinatorServer(Logger):
                         self.on_drop(slave)
                     return {"error": "dropped"}, True
                 if payload is not None:
-                    slave.current_job = (payload, time.time())
+                    slave.jobs_in_flight.append((payload, time.time()))
                     slave.state = "WORK"
                     return {"job": payload}, False
-                slave.state = "IDLE"
+                if not slave.jobs_in_flight:
+                    slave.state = "IDLE"
                 return {"job": None, "done": self.no_more_jobs}, False
         # action == "sink"
         try:
@@ -582,13 +663,18 @@ class CoordinatorClient(Logger):
 
     def __init__(self, address, checksum="", power=1.0,
                  death_probability=0.0, rand="chaos",
-                 heartbeat_interval=2.0):
+                 heartbeat_interval=2.0, pipeline=True):
         super(CoordinatorClient, self).__init__()
         self.address = tuple(address)
         self.checksum = checksum
         self.power = power
         self.death_probability = death_probability
         self.heartbeat_interval = heartbeat_interval
+        #: prefetch the next job while the current one computes.
+        #: Overlap costs one job of weight staleness (async SGD — the
+        #: reference's balance-2 protocol had the same property);
+        #: False = strict request→reply, bit-exact with standalone
+        self.pipeline = pipeline
         self._rand = prng.get(rand)
         self.id = None
         self.jobs_done = 0
@@ -635,25 +721,44 @@ class CoordinatorClient(Logger):
             except (ConnectionError, OSError):
                 return
 
-    def serve_forever(self, handler, idle_sleep=0.05, max_idle=None):
-        """Pull/execute/push until the queue stays empty (or forever)."""
+    def serve_forever(self, handler, idle_sleep=0.05, max_idle=None,
+                      pipeline=None):
+        """Pull/execute/push until the queue stays empty (or forever).
+
+        With ``pipeline`` (default) the next-job request goes out
+        BEFORE the current job is computed, so the master's job
+        generation and this slave's compute overlap — the reference's
+        async protocol (``client.py:433-437``), bounded by the
+        server's MAX_IN_FLIGHT. The prefetched job reply is READ
+        before the result is written: with multi-MB payloads, writing
+        the result while the server is still blocked writing the job
+        reply would fill both TCP buffers and deadlock both peers
+        (write-write deadlock) — draining first guarantees the server
+        is free to read."""
+        if pipeline is None:
+            pipeline = self.pipeline
         idle = 0
+        pending_job = None
         while True:
-            try:
-                self.proto.send({"cmd": "job"})
-                reply = self.proto.recv()
-            except (ConnectionError, OSError):
-                # master went away: nothing more to do for this slave
-                return self.jobs_done
-            job = reply.get("job")
-            if job is None:
-                if reply.get("done"):
+            if pending_job is not None:
+                job = pending_job
+                pending_job = None
+            else:
+                try:
+                    self.proto.send({"cmd": "job"})
+                    reply = self.proto.recv()
+                except (ConnectionError, OSError):
+                    # master went away: nothing more for this slave
                     return self.jobs_done
-                idle += 1
-                if max_idle is not None and idle >= max_idle:
-                    return self.jobs_done
-                time.sleep(idle_sleep)
-                continue
+                if reply.get("job") is None:
+                    if reply.get("done"):
+                        return self.jobs_done
+                    idle += 1
+                    if max_idle is not None and idle >= max_idle:
+                        return self.jobs_done
+                    time.sleep(idle_sleep)
+                    continue
+                job = reply["job"]
             idle = 0
             if self.death_probability and \
                     self._rand.rand() < self.death_probability:
@@ -661,16 +766,31 @@ class CoordinatorClient(Logger):
                 # probability parity) — the master must requeue
                 self.proto.close()
                 raise RuntimeError("chaos death")
+            prefetched = False
+            if pipeline:
+                try:
+                    self.proto.send({"cmd": "job"})
+                    prefetched = True
+                except (ConnectionError, OSError):
+                    prefetched = False
             result = handler(job)
             try:
+                if prefetched:
+                    # drain the job reply BEFORE writing the result:
+                    # see the write-write deadlock note above
+                    next_reply = self.proto.recv()
                 self.proto.send({"cmd": "result", "data": result})
-                self.proto.recv()
+                self.proto.recv()  # result ack
             except (ConnectionError, OSError):
                 # master shut down while we were computing — a normal
                 # end-of-run, not an error (the result is lost, but the
                 # master only closes once it has all it needs)
                 return self.jobs_done
             self.jobs_done += 1
+            if prefetched:
+                pending_job = next_reply.get("job")
+                if pending_job is None and next_reply.get("done"):
+                    return self.jobs_done
 
     def heartbeat(self):
         self.proto.send({"cmd": "heartbeat", "power": self.power})

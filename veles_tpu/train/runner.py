@@ -229,13 +229,15 @@ class FusedRunner(Logger):
         start = time.perf_counter()
         epochs_done = 0
         samples_done = 0
-        # eager fills confusion_matrix whenever the evaluator asks
-        # (compute_confusion defaults True) — MatrixPlotter or not;
-        # with a validation class it rides the eval scan for free, so
-        # only the validation-less fallback costs an extra sweep
+        # with a validation class the confusion matrix rides the eval
+        # scan for free (always filled, like eager); the validation-
+        # LESS fallback costs a whole extra TRAIN forward sweep, so it
+        # runs only when something actually consumes the matrix
+        from veles_tpu.plotting_units import MatrixPlotter
         confusion_from_train = (
             trainer.wants_confusion and
-            not loader.class_lengths[VALIDATION])
+            not loader.class_lengths[VALIDATION] and
+            any(isinstance(u, MatrixPlotter) for u in services))
         params = states = None
         try:
             params, states = trainer.pull_params()

@@ -1,0 +1,174 @@
+"""Slave-side execution of fused SEGMENT jobs.
+
+A segment job (``Workflow.generate_segment_for_slave``) carries the
+master's unit payloads (weights, decision state) plus a list of loader
+minibatch payloads. Executing it through the step compiler keeps the
+whole segment on-device — one weight pull, one compiled scan, one
+delta push — instead of the reference's per-minibatch eager dispatch
+(``veles/client.py`` ran the Twisted graph once per job).
+
+Workflows the step compiler cannot model fall back to an exact eager
+replay: the same minibatches run through ``Workflow.do_job`` one by
+one, producing the same update shape — so a ``--eager`` slave can
+serve a segment-mode master.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.logger import Logger
+from veles_tpu.train.runner import fused_compatible
+from veles_tpu.train.step import FusedTrainer
+
+
+def segment_capable(workflow):
+    """Master-side check: can this workflow SERVE segment jobs?
+
+    Weaker than :func:`fused_compatible` on purpose — the master has
+    no device (no resident dataset) and custom units are fine because
+    a slave that cannot fuse replays the segment eagerly."""
+    from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
+    for attr in ("loader", "forwards", "evaluator", "decision"):
+        if getattr(workflow, attr, None) is None:
+            return False
+    return isinstance(workflow.evaluator,
+                      (EvaluatorSoftmax, EvaluatorMSE))
+
+
+class SegmentExecutor(Logger):
+    """Executes segment jobs on a slave workflow."""
+
+    def __init__(self, workflow, eager=False):
+        super(SegmentExecutor, self).__init__()
+        self.workflow = workflow
+        self._trainer = None
+        reason = "--eager" if eager else fused_compatible(workflow)
+        self.eager = reason is not None
+        if self.eager:
+            self.info("segment jobs will replay eagerly (%s)", reason)
+
+    @property
+    def trainer(self):
+        if self._trainer is None:
+            self._trainer = FusedTrainer(self.workflow)
+        return self._trainer
+
+    def execute(self, job):
+        """job dict -> update list (``[(unit_name, payload)]``)."""
+        if self.eager:
+            return self._execute_eager(job)
+        return self._execute_fused(job)
+
+    # -- fused path --------------------------------------------------------
+
+    def _idx_matrix(self, batches):
+        mb = self.workflow.loader.max_minibatch_size
+        mat = numpy.full((len(batches), mb), -1, numpy.int32)
+        for i, batch in enumerate(batches):
+            idx = numpy.asarray(batch["indices"], numpy.int32)
+            mat[i, :len(idx)] = idx
+        return jnp.asarray(mat)
+
+    def _execute_fused(self, job):
+        wf = self.workflow
+        wf.apply_data_from_master(job["units"])
+        trainer = self.trainer
+        testing = bool(getattr(wf.decision, "testing", False))
+        params, states = trainer.pull_params()
+        stats = []
+        # the master guarantees batches are contiguous per class in the
+        # common case, but a concurrent requeue can interleave — split
+        # into homogeneous runs and scan each
+        for run in self._class_runs(job["batches"]):
+            klass = run[0]["class"]
+            idx = self._idx_matrix(run)
+            if klass == TRAIN and not testing:
+                base = trainer._dropout_base_key()
+                keys = jax.vmap(
+                    lambda i: jax.random.fold_in(base, i))(
+                    jnp.arange(idx.shape[0]))
+                params, states, losses, metrics = trainer._train_segment(
+                    params, states, idx, keys)
+            else:
+                out = trainer._eval_segment(params, idx)
+                losses, metrics = out[0], out[1]
+            metrics = numpy.asarray(metrics)
+            for i, batch in enumerate(run):
+                stats.append({
+                    "klass": klass, "samples": batch["size"],
+                    "metric": float(metrics[i]),
+                    "epoch": batch["epoch"],
+                    "last": batch["last"],
+                    "epoch_ended": batch["epoch_ended"]})
+        trainer.push_params(params, states)
+        wf.loader.samples_served += sum(b["size"] for b in job["batches"])
+        return self._collect_update(job, stats)
+
+    def _collect_update(self, job, stats):
+        wf = self.workflow
+        update = []
+        for unit in wf._distributed_units():
+            if unit is wf.loader:
+                update.append((unit.name, {
+                    "served": wf.loader.samples_served,
+                    "count": len(job["batches"])}))
+            elif unit is wf.decision:
+                update.append((unit.name, stats))
+            else:
+                update.append((unit.name, unit.generate_data_for_master()))
+        return update
+
+    @staticmethod
+    def _class_runs(batches):
+        runs = []
+        for batch in batches:
+            if runs and runs[-1][-1]["class"] == batch["class"] and \
+                    not runs[-1][-1]["last"]:
+                runs[-1].append(batch)
+            else:
+                runs.append([batch])
+        return runs
+
+    # -- eager replay fallback ---------------------------------------------
+
+    def _execute_eager(self, job):
+        wf = self.workflow
+        stats = []
+        gd_updates = {}
+        served = 0
+        for i, batch in enumerate(job["batches"]):
+            # unit payloads (weights, decision reset) apply once; later
+            # minibatches continue from the locally-updated weights,
+            # exactly like the fused scan
+            eager_job = (list(job["units"]) if i == 0 else
+                         [(name, {"reset_complete": True})
+                          for name, _ in job["units"]
+                          if name == wf.decision.name])
+            eager_job.append((wf.loader.name, batch))
+            update = wf.do_job(eager_job)
+            served += batch["size"]
+            for name, payload in update:
+                if payload is None:
+                    continue
+                if name == wf.decision.name:
+                    stats.append(payload)
+                elif name == wf.loader.name:
+                    pass
+                else:
+                    # gd payloads are deltas vs the weights applied at
+                    # batch 0 (``_job_base_params_`` is only set by
+                    # apply_data_from_master), so each batch's payload
+                    # is already CUMULATIVE — keep the last one
+                    gd_updates[name] = payload
+        update = []
+        for unit in wf._distributed_units():
+            if unit is wf.loader:
+                update.append((unit.name, {
+                    "served": served, "count": len(job["batches"])}))
+            elif unit is wf.decision:
+                update.append((unit.name, stats))
+            else:
+                update.append((unit.name, gd_updates.get(unit.name)))
+        return update

@@ -83,6 +83,9 @@ class FusedTrainer(Logger):
         EXACTLY: softmax err is (p - onehot)/batch (full padded batch,
         evaluator.py _softmax_eval), MSE err is diff/n_valid. The
         human-facing ``report_loss`` normalizes by valid rows."""
+        # loss math always reduces in f32, whatever the compute policy
+        # left the head output in
+        out = out.astype(jnp.float32)
         batch = out.shape[0]
         if self.loss_kind == "softmax":
             labels = labels_or_targets
