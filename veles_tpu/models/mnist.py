@@ -42,10 +42,12 @@ class MnistLoader(FullBatchLoader):
 
     hide_from_registry = True
 
-    def __init__(self, workflow, provider=None, **kwargs):
+    def __init__(self, workflow, provider=None, flatten=True, **kwargs):
         kwargs.setdefault("normalization_type", "linear")
         super(MnistLoader, self).__init__(workflow, **kwargs)
         self.provider = provider
+        #: flat (n, 784) for FC topologies, (n, 28, 28, 1) NHWC for conv
+        self.flatten = flatten
 
     def load_dataset(self):
         train_x, train_y, valid_x, valid_y = self.provider()
@@ -53,7 +55,11 @@ class MnistLoader(FullBatchLoader):
             numpy.float32)
         labels = numpy.concatenate([valid_y, train_y], axis=0).astype(
             numpy.int32)
-        self.original_data.reset(data.reshape(len(data), -1))
+        if self.flatten:
+            data = data.reshape(len(data), -1)
+        elif data.ndim == 3:
+            data = data[..., None]  # NHWC single channel
+        self.original_data.reset(data)
         self.original_labels.reset(labels)
         self.class_lengths = [0, len(valid_x), len(train_x)]
 
