@@ -24,51 +24,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def train_fc(provider, max_epochs=40):
-    from veles_tpu import prng
-    from veles_tpu.backends import Device
-    from veles_tpu.dummy import DummyLauncher
-    from veles_tpu.models.mnist import MnistWorkflow
-    from veles_tpu.train import FusedTrainer
-
-    prng.get().seed(1234)
-    prng.get("loader").seed(1235)
-    wf = MnistWorkflow(DummyLauncher(), provider=provider, layers=(100,),
-                       minibatch_size=100, learning_rate=0.1,
-                       max_epochs=max_epochs)
-    wf.initialize(device=Device(backend=None))
-    history = FusedTrainer(wf).train()
-    return min(h["validation"]["normalized"] for h in history)
-
-
-def train_conv(provider, max_epochs=25):
-    from veles_tpu import prng
-    from veles_tpu.backends import Device
-    from veles_tpu.dummy import DummyLauncher
-    from veles_tpu.models.mnist import MnistLoader
-    from veles_tpu.standard_workflow import StandardWorkflow
-    from veles_tpu.train import FusedTrainer
-
-    prng.get().seed(1234)
-    prng.get("loader").seed(1235)
-    wf = StandardWorkflow(
-        DummyLauncher(),
-        loader=lambda w: MnistLoader(w, provider=provider, flatten=False,
-                                     minibatch_size=100),
-        layers=[
-            {"type": "conv_relu", "n_kernels": 16, "kx": 5, "ky": 5},
-            {"type": "max_pooling", "kx": 2, "ky": 2},
-            {"type": "conv_relu", "n_kernels": 32, "kx": 5, "ky": 5},
-            {"type": "max_pooling", "kx": 2, "ky": 2},
-            {"type": "all2all_relu", "output_sample_shape": 100},
-            {"type": "softmax", "output_sample_shape": 10},
-        ],
-        loss="softmax", learning_rate=0.03, max_epochs=max_epochs)
-    wf.initialize(device=Device(backend=None))
-    history = FusedTrainer(wf).train()
-    return min(h["validation"]["normalized"] for h in history)
-
-
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--mnist-dir", default=None,
@@ -91,6 +46,7 @@ def main():
         dataset = "golden digits (committed, seed 2026, 12k/2k)"
         fc_target, conv_target = 0.0300, 0.0200
 
+    from veles_tpu.models.parity import train_conv, train_fc
     t = time.time()
     fc_err = train_fc(provider, args.fc_epochs)
     t_fc = time.time() - t

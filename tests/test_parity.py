@@ -6,43 +6,27 @@ Full-budget runs live in scripts/parity_run.py (results committed in
 docs/PARITY_RUNS.md: FC 2.60% / conv 0.30% against the reference's
 real-MNIST 1.48% / 0.73%); this file asserts the FC bar on every run
 (fast) and the conv bar under VELES_SLOW=1 (conv training is ~4 min on
-the CPU test backend; the script runs it in ~70 s on TPU).
+the CPU test backend; the script runs it in ~70 s on TPU). Both use
+the SAME builders (veles_tpu/models/parity.py) so the committed
+numbers and the tested configs cannot diverge.
 """
 
 import os
 
 import pytest
 
-from veles_tpu import prng
-from veles_tpu.backends import Device
 from veles_tpu.datasets import golden_digits
-from veles_tpu.dummy import DummyLauncher
-from veles_tpu.models.mnist import MnistLoader, MnistWorkflow
-from veles_tpu.train import FusedTrainer
+from veles_tpu.models.parity import train_conv, train_fc
 
-
-def _best_val(history):
-    return min(h["validation"]["normalized"] for h in history)
-
-
-def _train_fc(max_epochs, learning_rate=0.1, weights_decay=0.0):
-    prng.get().seed(1234)
-    prng.get("loader").seed(1235)
-    wf = MnistWorkflow(DummyLauncher(),
-                       provider=golden_digits(n_train=12000,
-                                              n_valid=1500),
-                       layers=(100,), minibatch_size=100,
-                       learning_rate=learning_rate,
-                       weights_decay=weights_decay,
-                       max_epochs=max_epochs)
-    wf.initialize(device=Device(backend="cpu"))
-    return _best_val(FusedTrainer(wf).train())
+#: one shared provider: the ~13.5k-sample scipy render happens once
+#: per test session (the instance caches the arrays)
+PROVIDER = golden_digits(n_train=12000, n_valid=1500)
 
 
 def test_fc_reaches_reference_class_error():
     """784-100-10 on golden digits: ≤4% validation error (full-budget
     run: 2.60%; reference real-MNIST baseline: 1.48%)."""
-    err = _train_fc(max_epochs=25)
+    err = train_fc(PROVIDER, max_epochs=25, backend="cpu")
     assert err <= 0.04, "FC golden-digit error %.3f > 4%%" % err
 
 
@@ -50,7 +34,8 @@ def test_crippled_optimizer_fails_the_bar():
     """Same topology, absurd weight decay: must NOT reach the bar —
     proof the threshold measures optimization quality, not dataset
     triviality."""
-    err = _train_fc(max_epochs=5, weights_decay=5.0)
+    err = train_fc(PROVIDER, max_epochs=5, weights_decay=5.0,
+                   backend="cpu")
     assert err > 0.20, "crippled run reached %.3f — bar has no teeth" % err
 
 
@@ -62,24 +47,6 @@ def test_conv_reaches_reference_class_error():
     """Reduced-budget conv run (10 epochs): the conv-beats-FC claim
     itself is asserted by the full-budget scripts/parity_run.py
     (0.30% vs 2.60%); at this budget conv is still breaking in."""
-    from veles_tpu.standard_workflow import StandardWorkflow
-    prng.get().seed(1234)
-    prng.get("loader").seed(1235)
-    provider = golden_digits(n_train=12000, n_valid=1500)
-    wf = StandardWorkflow(
-        DummyLauncher(),
-        loader=lambda w: MnistLoader(w, provider=provider, flatten=False,
-                                     minibatch_size=100),
-        layers=[
-            {"type": "conv_relu", "n_kernels": 12, "kx": 5, "ky": 5},
-            {"type": "max_pooling", "kx": 2, "ky": 2},
-            {"type": "conv_relu", "n_kernels": 24, "kx": 5, "ky": 5},
-            {"type": "max_pooling", "kx": 2, "ky": 2},
-            {"type": "all2all_relu", "output_sample_shape": 64},
-            {"type": "softmax", "output_sample_shape": 10},
-        ],
-        loss="softmax", learning_rate=0.03, max_epochs=10)
-    wf.initialize(device=Device(backend="cpu"))
-    conv_err = _best_val(FusedTrainer(wf).train())
+    conv_err = train_conv(PROVIDER, max_epochs=10, backend="cpu")
     assert conv_err <= 0.05, \
         "conv golden-digit error %.3f > 5%%" % conv_err

@@ -377,6 +377,12 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             self.info("running the eager per-unit scheduler (--eager)")
             self.run_mode_used = "eager"
             return workflow.run()
+        custom = workflow.make_fused_runner()
+        if custom is not None:
+            self.info("running the workflow's own fused runner (%s)",
+                      type(custom).__name__)
+            self.run_mode_used = "fused"
+            return custom.run()
         from veles_tpu.train.runner import FusedRunner, fused_compatible
         reason = fused_compatible(workflow)
         if reason is not None:

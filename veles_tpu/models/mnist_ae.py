@@ -131,3 +131,17 @@ class KohonenWorkflow(AcceleratedWorkflow):
         self.repeater.gate_block = self.counter.complete
         self.end_point.link_from(self.counter)
         self.end_point.gate_block = ~self.counter.complete
+
+    def make_fused_runner(self):
+        """BASELINE config 4 runs fused too: the SOM epoch compiles to
+        one scan (train/som.py) instead of per-unit eager dispatch."""
+        if getattr(self.loader.original_data, "mem", None) is None:
+            return None
+        offset = getattr(self.loader, "_global_offset", 0)
+        if 0 < offset < self.loader.total_samples:
+            # a mid-epoch snapshot resume must continue at the saved
+            # minibatch — the eager loop does that exactly; the fused
+            # epoch scan would replay the epoch from the top
+            return None
+        from veles_tpu.train.som import SOMFusedRunner
+        return SOMFusedRunner(self)

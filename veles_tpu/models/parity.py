@@ -1,0 +1,58 @@
+"""Shared builders for the accuracy-parity runs.
+
+One source of truth for the FC and conv parity configs, used by BOTH
+``scripts/parity_run.py`` (full budget, writes docs/PARITY_RUNS.md)
+and ``tests/test_parity.py`` (reduced budget, asserted in CI) — so
+the committed numbers and the continuously-tested configuration can
+never silently diverge.
+"""
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.models.mnist import MnistLoader, MnistWorkflow
+from veles_tpu.train import FusedTrainer
+
+#: the conv topology of BASELINE config 2's analog
+CONV_LAYERS = [
+    {"type": "conv_relu", "n_kernels": 16, "kx": 5, "ky": 5},
+    {"type": "max_pooling", "kx": 2, "ky": 2},
+    {"type": "conv_relu", "n_kernels": 32, "kx": 5, "ky": 5},
+    {"type": "max_pooling", "kx": 2, "ky": 2},
+    {"type": "all2all_relu", "output_sample_shape": 100},
+    {"type": "softmax", "output_sample_shape": 10},
+]
+
+
+def best_val(history):
+    return min(h["validation"]["normalized"] for h in history)
+
+
+def train_fc(provider, max_epochs, learning_rate=0.1, weights_decay=0.0,
+             backend=None):
+    """784-100-10 (BASELINE config 1); returns best validation error."""
+    prng.get().seed(1234)
+    prng.get("loader").seed(1235)
+    wf = MnistWorkflow(DummyLauncher(), provider=provider, layers=(100,),
+                       minibatch_size=100, learning_rate=learning_rate,
+                       weights_decay=weights_decay,
+                       max_epochs=max_epochs)
+    wf.initialize(device=Device(backend=backend))
+    return best_val(FusedTrainer(wf).train())
+
+
+def train_conv(provider, max_epochs, learning_rate=0.03, layers=None,
+               backend=None):
+    """Conv stack on 28x28 NHWC; returns best validation error."""
+    from veles_tpu.standard_workflow import StandardWorkflow
+    prng.get().seed(1234)
+    prng.get("loader").seed(1235)
+    wf = StandardWorkflow(
+        DummyLauncher(),
+        loader=lambda w: MnistLoader(w, provider=provider, flatten=False,
+                                     minibatch_size=100),
+        layers=layers if layers is not None else CONV_LAYERS,
+        loss="softmax", learning_rate=learning_rate,
+        max_epochs=max_epochs)
+    wf.initialize(device=Device(backend=backend))
+    return best_val(FusedTrainer(wf).train())

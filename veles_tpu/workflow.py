@@ -233,6 +233,13 @@ class Workflow(Container):
         return [(u.name, u.generate_data_for_slave_locked(slave))
                 for u in units]
 
+    def make_fused_runner(self):
+        """Hook for workflows with a custom compiled execution path
+        (e.g. the gradient-free SOM loop, :mod:`veles_tpu.train.som`).
+        None (default) = let the launcher pick the standard
+        FusedRunner/eager dispatch."""
+        return None
+
     def generate_segment_for_slave(self, slave=None, max_minibatches=8):
         """Collect a SEGMENT job: the non-loader unit payloads once
         (weights, decision state) plus up to ``max_minibatches``
