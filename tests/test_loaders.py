@@ -342,3 +342,59 @@ def test_decision_drop_slave_reopens_runahead_gate():
     assert not decision.has_data_for_slave
     decision.drop_slave("s1")
     assert decision.has_data_for_slave
+
+
+def test_image_augmenter_crop_scale_rotations(image_tree):
+    """Reference parity: scale + random crops x crop_number x
+    rotations x mirror multiply the TRAIN set; eval classes get one
+    deterministic center variant (veles/loader/image.py:444-567)."""
+    from veles_tpu.loader.image import FileImageLoader
+    prng.get("loader").seed(7)
+    loader = FileImageLoader(
+        DummyWorkflow(), train_paths=(str(image_tree / "train"),),
+        validation_paths=(str(image_tree / "valid"),),
+        scale=2.0, crop=(12, 12), crop_number=3,
+        rotations=(0.0, 0.3), mirror=True, minibatch_size=4)
+    _init_loader(loader)
+    # train: 12 imgs x 2 rotations x 2 flips x 3 crops = 144
+    assert loader.class_lengths[TRAIN] == 144
+    # valid: center crop only, one variant each
+    assert loader.class_lengths[1] == 8
+    # every sample landed on the crop shape (after 2x scale: 16x16->12x12)
+    assert loader.original_data.shape[1:] == (12, 12, 3)
+
+
+def test_image_augmenter_fractional_crop_and_determinism():
+    from veles_tpu.loader.image import ImageAugmenter
+    img = numpy.arange(16 * 16 * 3, dtype=numpy.float32).reshape(16, 16, 3)
+    prng.get("loader").seed(42)
+    aug = ImageAugmenter(crop=(0.5, 0.5), crop_number=2)
+    first = [v.copy() for v in aug.expand(img, train=True)]
+    assert all(v.shape == (8, 8, 3) for v in first)
+    prng.get("loader").seed(42)
+    second = aug.expand(img, train=True)
+    for a, b in zip(first, second):
+        numpy.testing.assert_array_equal(a, b)
+    # eval: deterministic center crop regardless of the stream
+    center = aug.expand(img, train=False)
+    assert len(center) == 1
+    numpy.testing.assert_array_equal(center[0], img[4:12, 4:12])
+
+
+def test_image_augmenter_random_mirror():
+    from veles_tpu.loader.image import ImageAugmenter
+    img = numpy.zeros((6, 6, 1), numpy.float32)
+    img[:, 0] = 1.0  # left edge marked
+    prng.get("loader").seed(3)
+    aug = ImageAugmenter(mirror="random")
+    flips = [bool(aug.expand(img, train=True)[0][0, -1, 0])
+             for _ in range(30)]
+    assert any(flips) and not all(flips)  # both outcomes occur
+
+
+def test_image_augmenter_rejects_oversized_crop():
+    from veles_tpu.loader.image import ImageAugmenter
+    img = numpy.zeros((28, 28, 1), numpy.float32)
+    aug = ImageAugmenter(crop=(32, 32))
+    with pytest.raises(ValueError, match="does not fit"):
+        aug.expand(img, train=False)

@@ -175,3 +175,67 @@ def test_launcher_notifier_posts_to_dashboard(server):
         launcher._finished.set()
         root.common.web.update({"host": saved[0], "port": saved[1],
                                 "notification_interval": saved[2]})
+
+
+def test_workflow_and_timeline_pages_served(server):
+    import urllib.request
+    for page, marker in (("/workflow.html", "workflow graph"),
+                         ("/timeline.html", "event timeline")):
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d%s" % (server.port, page),
+                timeout=10) as resp:
+            body = resp.read().decode()
+        assert marker in body
+
+
+def test_graph_description_shape():
+    import sys
+    sys.path.insert(0, "tests")
+    from test_mnist_e2e import synthetic_digits
+    from veles_tpu import prng
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.mnist import MnistWorkflow
+    prng.get().seed(1)
+    prng.get("loader").seed(2)
+    wf = MnistWorkflow(DummyLauncher(), provider=synthetic_digits(),
+                       layers=(8,), minibatch_size=60, max_epochs=1)
+    graph = wf.graph_description()
+    names = {n["name"] for n in graph["nodes"]}
+    assert {"MnistLoader", "evaluator", "decision"} <= names
+    ids = {n["id"] for n in graph["nodes"]}
+    assert all(s in ids and d in ids for s, d in graph["edges"])
+    assert graph["edges"]  # the control loop is wired
+    import json as json_mod
+    json_mod.dumps(graph)  # JSON-able for the status POST
+
+
+def test_event_sink_feeds_timeline(server):
+    from veles_tpu import logger as logger_mod
+    from veles_tpu.web_status import WebStatusEventSink
+
+    sink = logger_mod.add_event_sink(WebStatusEventSink(
+        address=("127.0.0.1", server.port), session_id="tl-test",
+        flush_interval=0.1))
+    try:
+        class Thing(logger_mod.Logger):
+            pass
+
+        thing = Thing()
+        thing.event("step", "begin")
+        thing.event("step", "end")
+        thing.event("mark", "single")
+        deadline = time.time() + 5
+        result = []
+        while time.time() < deadline:
+            _, reply = _post(server.address, "/service",
+                             {"request": "events",
+                              "find": {"session": "tl-test"}})
+            result = reply.get("result", [])
+            if len(result) >= 3:
+                break
+            time.sleep(0.1)
+        assert {r["type"] for r in result} == {"begin", "end", "single"}
+        assert all(r["instance"].startswith("Thing@") for r in result)
+    finally:
+        logger_mod.remove_event_sink(sink)
+        sink.close()

@@ -225,6 +225,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             self._connect_slave()
         if self.web_status:
             self._start_status_notifier()
+            self._attach_dashboard_sinks()
         return self
 
     def _launch_graphics(self):
@@ -338,6 +339,33 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             target=notify, daemon=True, name="status-notifier")
         self._status_thread.start()
 
+    def _attach_dashboard_sinks(self):
+        """Feed the dashboard's logs page and event timeline live
+        (the reference duplicated both into Mongo; here they POST)."""
+        import logging as logging_mod
+        from veles_tpu import logger as logger_mod
+        from veles_tpu.web_status import (WebStatusEventSink,
+                                          WebStatusLogHandler)
+        self._web_log_handler = WebStatusLogHandler(
+            session=self.log_id, node=self.mode)
+        logging_mod.getLogger().addHandler(self._web_log_handler)
+        self._web_event_sink = logger_mod.add_event_sink(
+            WebStatusEventSink(session_id=self.log_id))
+
+    def _detach_dashboard_sinks(self):
+        import logging as logging_mod
+        from veles_tpu import logger as logger_mod
+        handler = getattr(self, "_web_log_handler", None)
+        if handler is not None:
+            logging_mod.getLogger().removeHandler(handler)
+            handler.close()
+            self._web_log_handler = None
+        sink = getattr(self, "_web_event_sink", None)
+        if sink is not None:
+            logger_mod.remove_event_sink(sink)
+            sink.close()
+            self._web_event_sink = None
+
     def status(self):
         """Periodic master status JSON (``launcher.py:852-885``)."""
         wf = self.workflow
@@ -346,6 +374,14 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             slaves = {s.id: {"power": s.power, "state": s.state,
                              "jobs_done": s.jobs_done}
                       for s in self._server.snapshot_slaves()}
+        if wf is not None and getattr(self, "_graph_cache", None) is None:
+            try:
+                self._graph_cache = wf.graph_description()
+            except Exception:
+                # transient (e.g. racing a unit mutation): retry on the
+                # next status tick instead of blanking the graph view
+                # for the whole run
+                self._graph_cache = None
         return {
             "id": self.id, "log_id": self.log_id, "mode": self.mode,
             "name": wf.name if wf else None,
@@ -354,6 +390,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "slaves": slaves,
             "units": len(wf) if wf else 0,
             "stopped": self.stopped,
+            "graph": getattr(self, "_graph_cache", None),
         }
 
     def run(self):
@@ -431,6 +468,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             self._server.stop()
         if self._graphics_server is not None:
             self._graphics_server.stop()
+        self._detach_dashboard_sinks()
 
     def __repr__(self):
         return "<Launcher %s mode=%s>" % (self.log_id, self.mode)

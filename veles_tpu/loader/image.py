@@ -49,6 +49,116 @@ def decode_image(path, size=None, color="RGB"):
     return arr
 
 
+class ImageAugmenter(object):
+    """Staging-time augmentation (``veles/loader/image.py:444-567``
+    re-designed for the device-resident full batch).
+
+    The reference distorted per minibatch on the host (cv2 warpAffine,
+    random crops around a bbox, mirror variants, rotation set); here
+    every variant is materialized ONCE at load time into the full
+    batch, so the training loop stays a pure on-device gather. TRAIN
+    samples multiply by ``len(rotations) × mirror-factor ×
+    crop_number``; eval classes get the deterministic center variant
+    (rotation 0, no flip, center crop) so shapes match.
+
+    * ``scale``: float ratio (bilinear resize) or ``(h, w)`` target;
+    * ``crop``: ``(h, w)`` ints or floats (fraction of the scaled
+      shape); train crops are uniform-random, eval crops centered;
+    * ``crop_number``: random crops per train variant;
+    * ``mirror``: False | True (every variant also flipped) |
+      ``"random"`` (each variant flips with p=0.5);
+    * ``rotations``: radians, each multiplies the train set.
+
+    Randomness comes from the seeded PRNG registry (snapshot-
+    preserved), so staging is reproducible.
+    """
+
+    def __init__(self, crop=None, crop_number=1, scale=1.0,
+                 rotations=(0.0,), mirror=False, rand="loader"):
+        if mirror not in (False, True, "random"):
+            raise ValueError("mirror must be False, True or 'random'")
+        self.crop = tuple(crop) if crop is not None else None
+        self.crop_number = int(crop_number)
+        self.scale = scale
+        self.rotations = tuple(rotations)
+        self.mirror = mirror
+        self.rand_name = rand
+
+    def _rng(self):
+        from veles_tpu import prng
+        return prng.get(self.rand_name)
+
+    def _scaled(self, img):
+        from scipy import ndimage
+        if self.scale == 1.0:
+            return img
+        if isinstance(self.scale, tuple):
+            zoom = (self.scale[0] / img.shape[0],
+                    self.scale[1] / img.shape[1], 1.0)
+        else:
+            zoom = (self.scale, self.scale, 1.0)
+        return ndimage.zoom(img, zoom, order=1).astype(numpy.float32)
+
+    def _crop_shape(self, shape):
+        if self.crop is None:
+            return None
+        cs = tuple(int(c if isinstance(c, int) else round(c * s))
+                   for c, s in zip(self.crop, shape[:2]))
+        if cs[0] > shape[0] or cs[1] > shape[1] or min(cs) < 1:
+            # fail with the configuration error, not a cryptic
+            # numpy.stack shape mismatch (or a silent short slice)
+            raise ValueError(
+                "crop %s does not fit the scaled image shape %s" %
+                (cs, tuple(shape[:2])))
+        return cs
+
+    def _cut(self, img, oy, ox, ch, cw):
+        return img[oy:oy + ch, ox:ox + cw]
+
+    def _rotated(self, img, rot):
+        if not rot:
+            return img
+        from scipy import ndimage
+        return ndimage.rotate(img, rot * 180.0 / numpy.pi, order=1,
+                              reshape=False, mode="constant",
+                              cval=0.0).astype(numpy.float32)
+
+    def expand(self, img, train):
+        """One decoded image → list of augmented variants."""
+        img = self._scaled(img)
+        cs = self._crop_shape(img.shape)
+        if not train:
+            img = self._rotated(img, 0.0)
+            if cs is not None:
+                oy = (img.shape[0] - cs[0]) // 2
+                ox = (img.shape[1] - cs[1]) // 2
+                img = self._cut(img, oy, ox, *cs)
+            return [img]
+        rng = self._rng()
+        out = []
+        for rot in self.rotations:
+            base = self._rotated(img, rot)
+            if self.mirror is True:
+                flips = (False, True)
+            elif self.mirror == "random":
+                flips = (bool(rng.randint(2)),)
+            else:
+                flips = (False,)
+            for flip in flips:
+                variant = base[:, ::-1] if flip else base
+                if cs is None:
+                    out.append(numpy.ascontiguousarray(variant))
+                    continue
+                max_oy = variant.shape[0] - cs[0]
+                max_ox = variant.shape[1] - cs[1]
+                for _ in range(self.crop_number):
+                    oy = rng.randint(max_oy + 1) if max_oy > 0 else 0
+                    ox = rng.randint(max_ox + 1) if max_ox > 0 else 0
+                    out.append(numpy.ascontiguousarray(
+                        self._cut(variant, oy, ox, *cs)))
+        return out
+
+
 class ImageScanner(LabeledFileScanner):
     """Image-extension scan; labels from parent directory names."""
 
@@ -68,9 +178,16 @@ class FileImageLoader(FullBatchLoader):
         self.train_paths = tuple(kwargs.pop("train_paths", ()))
         self.size = kwargs.pop("size", None)        # (H, W) resize target
         self.color_space = kwargs.pop("color_space", "RGB")
-        self.mirror = kwargs.pop("mirror", False)   # train-time flip copies
         self.filename_re = kwargs.pop("filename_re", None)
         self.ignored_dirs = kwargs.pop("ignored_dirs", ())
+        self.augmenter = kwargs.pop("augmenter", None)
+        if self.augmenter is None:
+            self.augmenter = ImageAugmenter(
+                crop=kwargs.pop("crop", None),
+                crop_number=kwargs.pop("crop_number", 1),
+                scale=kwargs.pop("scale", 1.0),
+                rotations=kwargs.pop("rotations", (0.0,)),
+                mirror=kwargs.pop("mirror", False))
         super(FileImageLoader, self).__init__(workflow, **kwargs)
         self.labels_mapping = {}
 
@@ -100,11 +217,9 @@ class FileImageLoader(FullBatchLoader):
             count = 0
             for path, label in pairs:
                 img = decode_image(path, self.size, self.color_space)
-                data.append(img)
-                labels.append(self.labels_mapping[label])
-                count += 1
-                if self.mirror and klass == TRAIN:
-                    data.append(img[:, ::-1])
+                for variant in self.augmenter.expand(
+                        img, train=klass == TRAIN):
+                    data.append(variant)
                     labels.append(self.labels_mapping[label])
                     count += 1
             self.class_lengths[klass] = count

@@ -95,6 +95,7 @@ class EventWriter(object):
 
 
 _event_writer = None
+_event_sinks = []
 
 
 def duplicate_events_to_file(path, session_id=None):
@@ -104,8 +105,23 @@ def duplicate_events_to_file(path, session_id=None):
     return _event_writer
 
 
+def add_event_sink(sink):
+    """Register an additional event consumer (``sink.write(record)``;
+    needs a ``session_id``) — e.g. the dashboard's live timeline
+    poster (:class:`veles_tpu.web_status.WebStatusEventSink`)."""
+    _event_sinks.append(sink)
+    return sink
+
+
+def remove_event_sink(sink):
+    try:
+        _event_sinks.remove(sink)
+    except ValueError:
+        pass
+
+
 def events_active():
-    return _event_writer is not None
+    return _event_writer is not None or bool(_event_sinks)
 
 
 class Logger(object):
@@ -182,12 +198,11 @@ class Logger(object):
         ``etype`` is "begin" | "end" | "single" — the contract of
         ``veles/logger.py:264-289``; no-op unless a sink is active.
         """
-        if _event_writer is None:
+        if _event_writer is None and not _event_sinks:
             return
         if etype not in ("begin", "end", "single"):
             raise ValueError("bad event type %r" % etype)
         record = {
-            "session": _event_writer.session_id,
             "instance": "%s@%x" % (type(self).__name__, id(self)),
             "name": name,
             "type": etype,
@@ -195,4 +210,11 @@ class Logger(object):
             "thread": threading.current_thread().name,
         }
         record.update(attrs)
-        _event_writer.write(record)
+        # each consumer gets ITS session identity — the dashboard
+        # filters its timeline by the launcher's log_id while the file
+        # stream keeps the pid.time session
+        if _event_writer is not None:
+            _event_writer.write(dict(
+                record, session=_event_writer.session_id))
+        for sink in _event_sinks:
+            sink.write(dict(record, session=sink.session_id))

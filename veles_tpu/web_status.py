@@ -39,6 +39,10 @@ th { background: #eee; }
 .dead { color: #999; }
 </style></head><body>
 <h1>veles_tpu workflows</h1>
+<p><a href="/workflow.html">graph view</a> ·
+<a href="/timeline.html">event timeline</a> ·
+<a href="/logs.html">logs</a> ·
+<a href="/frontend.html">command composer</a></p>
 <table id="wf"><thead><tr>
 <th>id</th><th>name</th><th>mode</th><th>master</th><th>uptime</th>
 <th>slaves</th><th>units</th><th>stopped</th>
@@ -189,6 +193,187 @@ load();
 </script></body></html>"""
 
 
+_WORKFLOW_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu workflow graph</title><style>
+body { font-family: sans-serif; margin: 2em; background: #fafafa; }
+svg { background: #fff; border: 1px solid #ccc; }
+text { font-size: 11px; font-family: sans-serif; }
+.node rect { fill: #e8eef7; stroke: #5b7db1; rx: 4; }
+.node.PLUMBING rect { fill: #f4f4f4; stroke: #999; }
+.node.SERVICE rect, .node.PLOTTER rect { fill: #f1e8f7; stroke: #8b5bb1; }
+.node.TRAINER rect { fill: #e8f7ec; stroke: #4d9a63; }
+.edge { stroke: #888; fill: none; marker-end: url(#arrow); }
+select { margin-bottom: 1em; }
+</style></head><body>
+<h1>workflow graph</h1>
+<select id="master"></select>
+<div id="view"></div>
+<script>
+// layered layout (Sugiyama-lite): BFS ranks from the roots, then
+// order-within-rank by mean parent position — the role the
+// reference's viz.js svg_view.js played, without the 2MB dependency
+function layout(nodes, edges) {
+  const succ = new Map(nodes.map(n => [n.id, []]));
+  const indeg = new Map(nodes.map(n => [n.id, 0]));
+  for (const [s, d] of edges) {
+    succ.get(s).push(d);
+    indeg.set(d, indeg.get(d) + 1);
+  }
+  const rank = new Map();
+  let frontier = nodes.filter(n => indeg.get(n.id) === 0).map(n => n.id);
+  if (!frontier.length && nodes.length) frontier = [nodes[0].id];
+  let depth = 0;
+  const seen = new Set(frontier);
+  while (frontier.length) {
+    for (const id of frontier) rank.set(id, depth);
+    const next = [];
+    for (const id of frontier)
+      for (const d of succ.get(id) || [])
+        if (!seen.has(d)) { seen.add(d); next.push(d); }
+    frontier = next; depth++;
+  }
+  for (const n of nodes) if (!rank.has(n.id)) rank.set(n.id, depth);
+  const layers = [];
+  for (const n of nodes) {
+    const r = rank.get(n.id);
+    (layers[r] = layers[r] || []).push(n);
+  }
+  const pos = new Map();
+  layers.forEach((layer, r) => {
+    layer.forEach((n, i) => pos.set(n.id,
+      {x: 40 + i * 170 + (r % 2) * 40, y: 40 + r * 80}));
+  });
+  return pos;
+}
+function render(graph) {
+  const pos = layout(graph.nodes, graph.edges);
+  const w = Math.max(...[...pos.values()].map(p => p.x)) + 200;
+  const h = Math.max(...[...pos.values()].map(p => p.y)) + 80;
+  let svg = `<svg width="${w}" height="${h}">
+    <defs><marker id="arrow" viewBox="0 0 10 10" refX="9" refY="5"
+      markerWidth="7" markerHeight="7" orient="auto-start-reverse">
+      <path d="M 0 0 L 10 5 L 0 10 z" fill="#888"/></marker></defs>`;
+  for (const [s, d] of graph.edges) {
+    const a = pos.get(s), b = pos.get(d);
+    if (!a || !b) continue;
+    const my = (a.y + b.y) / 2;
+    svg += `<path class="edge" d="M ${a.x + 65} ${a.y + 36}
+      C ${a.x + 65} ${my}, ${b.x + 65} ${my}, ${b.x + 65} ${b.y}"/>`;
+  }
+  for (const n of graph.nodes) {
+    const p = pos.get(n.id);
+    svg += `<g class="node ${n.group || ""}"
+      transform="translate(${p.x},${p.y})">
+      <rect width="130" height="36"/>
+      <text x="65" y="15" text-anchor="middle">${n.type}</text>
+      <text x="65" y="29" text-anchor="middle" fill="#555">${n.name}</text>
+      </g>`;
+  }
+  document.getElementById("view").innerHTML = svg + "</svg>";
+}
+async function refresh() {
+  const resp = await fetch("/service", {method: "POST",
+    headers: {"Content-Type": "application/json"},
+    body: JSON.stringify({request: "workflows",
+                          args: ["name", "graph"]})});
+  const data = await resp.json();
+  const sel = document.getElementById("master");
+  const current = sel.value;
+  sel.innerHTML = "";
+  for (const [mid, wf] of Object.entries(data.result || {})) {
+    if (!wf.graph) continue;
+    const opt = document.createElement("option");
+    opt.value = mid;
+    opt.textContent = mid.slice(0, 8) + "  " + (wf.name || "");
+    sel.appendChild(opt);
+  }
+  if (current) sel.value = current;
+  const pick = (data.result || {})[sel.value];
+  if (pick && pick.graph) render(pick.graph);
+}
+document.getElementById("master").addEventListener("change", refresh);
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+_TIMELINE_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu timeline</title><style>
+body { font-family: sans-serif; margin: 2em; background: #fafafa; }
+svg { background: #fff; border: 1px solid #ccc; }
+text { font-size: 10px; font-family: monospace; }
+rect.bar { fill: #5b7db1; opacity: 0.8; }
+rect.bar:hover { opacity: 1; }
+line.single { stroke: #b14d4d; stroke-width: 2; }
+</style></head><body>
+<h1>event timeline</h1>
+<p>begin/end trace records per instance (the role of the reference's
+Rickshaw logs view); newest 60s window, refreshed live.</p>
+<div id="view"></div>
+<script>
+async function refresh() {
+  const resp = await fetch("/service", {method: "POST",
+    headers: {"Content-Type": "application/json"},
+    body: JSON.stringify({request: "events", find: {}})});
+  const data = await resp.json();
+  const events = data.result || [];
+  if (!events.length) {
+    document.getElementById("view").textContent = "no events yet";
+    return;
+  }
+  const tmax = Math.max(...events.map(e => e.time || 0));
+  const tmin = Math.max(Math.min(...events.map(e => e.time || 0)),
+                        tmax - 60);
+  const lanes = new Map();   // instance -> lane index
+  const open = new Map();    // instance:name -> begin time
+  const bars = [], singles = [];
+  for (const ev of events) {
+    if (ev.time < tmin - 60) continue;
+    if (!lanes.has(ev.instance)) lanes.set(ev.instance, lanes.size);
+    const key = ev.instance + ":" + ev.name;
+    if (ev.type === "begin") open.set(key, ev.time);
+    else if (ev.type === "end" && open.has(key)) {
+      bars.push({lane: lanes.get(ev.instance), name: ev.name,
+                 t0: open.get(key), t1: ev.time});
+      open.delete(key);
+    } else if (ev.type === "single")
+      singles.push({lane: lanes.get(ev.instance), name: ev.name,
+                    t: ev.time});
+  }
+  const W = 1100, laneH = 22, left = 240;
+  const H = lanes.size * laneH + 40;
+  const x = t => left + (W - left - 20) *
+    (t - tmin) / Math.max(tmax - tmin, 1e-3);
+  let svg = `<svg width="${W}" height="${H}">`;
+  for (const [inst, lane] of lanes) {
+    svg += `<text x="4" y="${30 + lane * laneH + 12}">` +
+      inst.split("@")[0].slice(0, 30) + `</text>`;
+    svg += `<line x1="${left}" y1="${30 + lane * laneH + laneH - 2}"
+      x2="${W - 10}" y2="${30 + lane * laneH + laneH - 2}"
+      stroke="#eee"/>`;
+  }
+  for (const b of bars) {
+    if (b.t1 < tmin) continue;
+    const x0 = x(Math.max(b.t0, tmin));
+    svg += `<rect class="bar" x="${x0}" y="${30 + b.lane * laneH + 2}"
+      width="${Math.max(x(b.t1) - x0, 1.5)}" height="${laneH - 6}">
+      <title>${b.name}: ${((b.t1 - b.t0) * 1000).toFixed(1)}ms</title>
+      </rect>`;
+  }
+  for (const s of singles) {
+    if (s.t < tmin) continue;
+    svg += `<line class="single" x1="${x(s.t)}" x2="${x(s.t)}"
+      y1="${30 + s.lane * laneH + 2}" y2="${30 + s.lane * laneH + laneH - 4}">
+      <title>${s.name}</title></line>`;
+  }
+  svg += `<text x="${left}" y="16">${new Date(tmin * 1000)
+    .toISOString()}</text>
+    <text x="${W - 200}" y="16">${new Date(tmax * 1000)
+    .toISOString()}</text>`;
+  document.getElementById("view").innerHTML = svg + "</svg>";
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
 def _match(record, query):
     """MongoDB-lite ``find``: top-level equality (+ $in / $gte / $lte)."""
     for key, cond in query.items():
@@ -242,6 +427,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(_LOGS_PAGE, ctype="text/html; charset=utf-8")
         elif self.path.startswith("/frontend.html"):
             self._reply(_FRONTEND_PAGE, ctype="text/html; charset=utf-8")
+        elif self.path.startswith("/workflow.html"):
+            self._reply(_WORKFLOW_PAGE, ctype="text/html; charset=utf-8")
+        elif self.path.startswith("/timeline.html"):
+            self._reply(_TIMELINE_PAGE, ctype="text/html; charset=utf-8")
         elif self.path.startswith("/catalog"):
             try:
                 body = json.dumps(self.server.owner.catalog(),
@@ -393,6 +582,55 @@ class WebStatusServer(Logger):
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+
+
+class WebStatusEventSink(object):
+    """Live event feed to the dashboard timeline: batches trace records
+    and POSTs them to ``/events`` (register with
+    :func:`veles_tpu.logger.add_event_sink`)."""
+
+    def __init__(self, address=None, session_id=None,
+                 flush_interval=1.0):
+        if address is None:
+            address = (root.common.web.host, root.common.web.port)
+        self.url = "http://%s:%d/events" % tuple(address)
+        self.session_id = session_id or str(time.time())
+        self._buffer = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, args=(flush_interval,), daemon=True,
+            name="web-status-events")
+        self._flusher.start()
+
+    def write(self, record):
+        with self._lock:
+            self._buffer.append(record)
+
+    def _flush_once(self):
+        import urllib.request
+        with self._lock:
+            batch, self._buffer = self._buffer, []
+        if not batch:
+            return
+        try:
+            req = urllib.request.Request(
+                self.url, data=json.dumps({"events": batch},
+                                          default=str).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=2.0)
+        except Exception:
+            with self._lock:  # keep for the next attempt, bounded
+                self._buffer = (batch + self._buffer)[-10000:]
+
+    def _flush_loop(self, interval):
+        while not self._stop.wait(interval):
+            self._flush_once()
+
+    def close(self):
+        self._stop.set()
+        self._flusher.join(timeout=5)
+        self._flush_once()
 
 
 class WebStatusLogHandler(logging.Handler):

@@ -335,6 +335,19 @@ class Workflow(Container):
                 digest.update(dst.name.encode())
         return digest.hexdigest()
 
+    def graph_description(self):
+        """JSON-able control-flow graph for the dashboard's inline SVG
+        view (the role of the reference's viz.js ``svg_view.js``)."""
+        units = list(dict.fromkeys(
+            [self.start_point, self.end_point] + self._units))
+        ids = {unit: i for i, unit in enumerate(units)}
+        nodes = [{"id": ids[u], "name": u.name,
+                  "type": type(u).__name__,
+                  "group": u.view_group} for u in units]
+        edges = [[ids[src], ids[dst]] for src in units
+                 for dst in src.links_to if dst in ids]
+        return {"nodes": nodes, "edges": edges}
+
     def generate_graph(self):
         """DOT source of the control-flow graph (``workflow.py:628-754``)."""
         lines = ["digraph %s {" % self.name.replace(" ", "_"),
