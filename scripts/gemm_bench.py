@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""GEMM discipline benchmark (VERDICT r1 item #10).
+
+Times XLA dot, pallas_gemm, pallas_kahan_gemm and the fori-loop Kahan
+at the reference's 1500^2 computing-power shape
+(``veles/accelerated_units.py:713-778``) and the AlexNet fc shapes,
+printing a Markdown table (appended to docs/PERF.md by hand).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def bench(fn, a, b, iters=30):
+    """Chained in-jit iterations: the remote-dispatch relay costs
+    ~5 ms per call, so timing per-call would measure the wire. The
+    scalar carry serializes steps and defeats CSE."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(c, _):
+        out = fn(a + c, b)
+        return out[0, 0] * 1e-30, None
+
+    chain = jax.jit(lambda: jax.lax.scan(
+        body, jnp.float32(0), None, length=iters)[0])
+    float(chain())  # compile + force
+    t = time.time()
+    float(chain())
+    dt = time.time() - t
+    flops = 2 * a.shape[0] * a.shape[1] * b.shape[1] * iters
+    return flops / dt / 1e12, dt / iters * 1000
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy
+
+    from veles_tpu.ops.gemm import (_kahan_matmul_loop, pallas_gemm,
+                                    pallas_kahan_gemm)
+
+    rng = numpy.random.RandomState(0)
+    shapes = [
+        ("1500^2 (reference computing_power)", (1500, 1500, 1500)),
+        ("AlexNet fc6 fwd (128x9216 @ 9216x4096)", (128, 9216, 4096)),
+        ("AlexNet fc7 fwd (128x4096 @ 4096x4096)", (128, 4096, 4096)),
+        ("AlexNet fc6 wgrad (9216x128 @ 128x4096)", (9216, 128, 4096)),
+        ("4096^3 (tileable square)", (4096, 4096, 4096)),
+    ]
+    xla = jax.jit(lambda a, b: jnp.dot(
+        a, b, preferred_element_type=jnp.float32))
+    kloop = jax.jit(_kahan_matmul_loop)
+    rows = ["| shape | XLA dot | pallas_gemm | pallas Kahan | "
+            "fori Kahan |", "|---|---|---|---|---|"]
+    for name, (m, k, n) in shapes:
+        a = jnp.asarray(rng.rand(m, k).astype("f") - 0.5)
+        b = jnp.asarray(rng.rand(k, n).astype("f") - 0.5)
+        cells = []
+        for fname, fn in (("xla", xla), ("pallas", pallas_gemm),
+                          ("pallas_kahan", pallas_kahan_gemm),
+                          ("kahan_loop", kloop)):
+            print("  %s %s..." % (name, fname), file=sys.stderr,
+                  flush=True)
+            try:
+                tf, ms = bench(fn, a, b)
+                cells.append("%.1f TF/s (%.2f ms)" % (tf, ms))
+            except Exception as e:
+                cells.append("error: %s" % type(e).__name__)
+        rows.append("| %s | %s |" % (name, " | ".join(cells)))
+        print(rows[-1], flush=True)
+    print("\n".join(rows[:2] + rows[2:]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -179,3 +179,20 @@ class TestJoin(object):
     def test_empty_raises(self):
         with pytest.raises(ValueError):
             join_arrays()
+
+
+def test_pallas_kahan_gemm_matches_loop_kahan():
+    """The Pallas Kahan carrier (precision_level=1 on TPU) must agree
+    with the fori-loop Kahan to f32 roundoff; off-TPU it falls back to
+    the loop itself, so this asserts the dispatch contract both ways."""
+    import numpy
+    from veles_tpu.ops.gemm import (_kahan_matmul_loop, gemm,
+                                    pallas_kahan_gemm)
+    rng = numpy.random.RandomState(5)
+    a = jnp.asarray((rng.rand(256, 512) - 0.5).astype("f"))
+    b = jnp.asarray((rng.rand(512, 256) - 0.5).astype("f"))
+    loop = numpy.asarray(_kahan_matmul_loop(a, b))
+    fused = numpy.asarray(pallas_kahan_gemm(a, b))
+    numpy.testing.assert_allclose(fused, loop, rtol=1e-6, atol=1e-4)
+    via_gemm = numpy.asarray(gemm(a, b, precision_level=1))
+    numpy.testing.assert_allclose(via_gemm, loop, rtol=1e-6, atol=1e-4)

@@ -14,9 +14,15 @@ Re-provides the reference's matrix-multiplication kernel family
   into partials that are reduced pairwise (``PRECISION_LEVEL 2``).
 
 Levels 1/2 exist for numerical-parity experiments; level 0 is what
-training uses. A hand-written Pallas tiled kernel (``pallas_gemm``) is
-provided both as the Kahan carrier and as a reference point for
-benchmarking against XLA's native dot.
+training uses. Measured against XLA's native dot on one v5e chip
+(scripts/gemm_bench.py, chained steady-state): the hand-tiled Pallas
+kernels match or beat XLA on latency/bandwidth-bound shapes (AlexNet
+fc6 wgrad 2.5 vs 1.5 TF/s; 1500² parity) but XLA's tiling wins ~2× on
+large compute-bound squares (4096³: 40 vs 18 TF/s) — so level 0 stays
+on XLA dot, and the Pallas kernels' real value is
+``pallas_kahan_gemm``: compensated accumulation at ≈ the plain Pallas
+kernel's speed (18.7 vs 18.4 TF/s), where the reference's
+``PRECISION_LEVEL 1`` traded GEMM throughput for it.
 """
 
 import functools
@@ -40,6 +46,9 @@ def gemm(a, b, transpose_a=False, transpose_b=False, alpha=1.0, beta=0.0,
     if precision_level <= 0:
         out = jnp.dot(a, b, preferred_element_type=jnp.float32)
     elif precision_level == 1:
+        # on TPU with tileable shapes the Kahan carrier is the Pallas
+        # kernel (compensation lives in VMEM next to the accumulator);
+        # the fori_loop fallback covers CPU and ragged shapes
         out = kahan_matmul(a, b)
     else:
         out = pairwise_matmul(a, b)
@@ -77,7 +86,17 @@ def pairwise_matmul(a, b, parts=None):
 
 
 def kahan_matmul(a, b, chunk=None):
-    """PRECISION_LEVEL 1: Kahan-compensated accumulation over K chunks."""
+    """PRECISION_LEVEL 1: Kahan-compensated accumulation over K chunks.
+
+    Dispatches to :func:`pallas_kahan_gemm` on TPU when the shapes
+    tile (the compensated accumulator never leaves VMEM); otherwise an
+    XLA ``fori_loop`` of chunked dots carries the compensation."""
+    if _on_tpu() and chunk is None and _tileable(a, b):
+        return pallas_kahan_gemm(a, b)
+    return _kahan_matmul_loop(a, b, chunk)
+
+
+def _kahan_matmul_loop(a, b, chunk=None):
     m, k = a.shape
     n = b.shape[1]
     if chunk is None:
@@ -114,6 +133,17 @@ def kahan_matmul(a, b, chunk=None):
 # Pallas tiled GEMM (TPU): MXU-tiled with fp32 VMEM accumulator.
 # ---------------------------------------------------------------------------
 
+#: default tile sizes for the Pallas kernels
+_BM, _BN, _BK = 256, 256, 512
+
+
+def _tileable(a, b, bm=_BM, bn=_BN, bk=_BK):
+    m, k = a.shape
+    n = b.shape[1]
+    bm, bn, bk = min(bm, m), min(bn, n), min(bk, k)
+    return m % bm == 0 and n % bn == 0 and k % bk == 0
+
+
 def _gemm_kernel(a_ref, b_ref, o_ref, acc_ref, *, k_steps):
     @jax.named_scope("init")
     def init():
@@ -133,9 +163,74 @@ def _gemm_kernel(a_ref, b_ref, o_ref, acc_ref, *, k_steps):
         o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
 
+def _kahan_gemm_kernel(a_ref, b_ref, o_ref, acc_ref, comp_ref, *,
+                       k_steps):
+    """Tiled GEMM whose K-accumulation is Kahan-compensated IN VMEM —
+    the fused realization of the reference's ``PRECISION_LEVEL 1``
+    summation (``ocl/matrix_multiplication_subsum.cl``): each K-step's
+    partial product joins the accumulator through the compensated
+    add, and neither the accumulator nor the compensation ever round-
+    trips to HBM."""
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        comp_ref[...] = jnp.zeros_like(comp_ref)
+
+    term = jnp.dot(a_ref[...], b_ref[...],
+                   preferred_element_type=jnp.float32)
+    y = term - comp_ref[...]
+    t = acc_ref[...] + y
+    comp_ref[...] = (t - acc_ref[...]) - y
+    acc_ref[...] = t
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk",
+                                             "out_dtype"))
+def pallas_kahan_gemm(a, b, bm=_BM, bn=_BN, bk=_BK, out_dtype=None):
+    """Kahan-compensated tiled MXU matmul (precision_level=1 carrier)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = a.shape
+    _, n = b.shape
+    bm, bn, bk = min(bm, m), min(bn, n), min(bk, k)
+    if m % bm or n % bn or k % bk or not _on_tpu():
+        return _kahan_matmul_loop(a, b)
+    k_steps = k // bk
+    out_dtype = out_dtype or jnp.float32
+    return pl.pallas_call(
+        functools.partial(_kahan_gemm_kernel, k_steps=k_steps),
+        grid=(m // bm, n // bn, k_steps),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, s: (i, s)),
+            pl.BlockSpec((bk, bn), lambda i, j, s: (s, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, s: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32),
+                        pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=(m * k + k * n + m * n) * a.dtype.itemsize,
+            transcendentals=0),
+    )(a, b)
+
+
 @functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "out_dtype"))
 def pallas_gemm(a, b, bm=256, bn=256, bk=512, out_dtype=None):
-    """Hand-tiled MXU matmul; shapes must divide by the tile sizes."""
+    """Hand-tiled MXU matmul; shapes must divide by the tile sizes.
+
+    Competitive with XLA dot on thin/bandwidth-bound shapes, ~2×
+    behind on large squares (see the module docstring's measurements)
+    — kept as the uncompensated twin of :func:`pallas_kahan_gemm`."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
