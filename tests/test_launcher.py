@@ -247,3 +247,27 @@ def test_cli_version(capsys):
     assert main(["--version"]) == 0
     from veles_tpu import __version__
     assert __version__ in capsys.readouterr().out
+
+
+def test_precision_flag_end_to_end(workflow_file, tmp_path):
+    """--precision bfloat16_mixed through the CLI trains to the same
+    loss class as float32."""
+    import json
+    from veles_tpu.__main__ import Main
+    from veles_tpu.nn.precision import set_policy
+
+    path = workflow_file
+    try:
+        out32 = str(tmp_path / "f32.json")
+        outmix = str(tmp_path / "mix.json")
+        assert Main().run([str(path), "-s", "7",
+                           "--result-file", out32]) == 0
+        assert Main().run([str(path), "-s", "7",
+                           "--precision", "bfloat16_mixed",
+                           "--result-file", outmix]) == 0
+        r32 = json.load(open(out32))
+        rmix = json.load(open(outmix))
+        assert rmix["epochs"] == r32["epochs"]
+        assert abs(rmix["best_n_err_pt"] - r32["best_n_err_pt"]) <= 0.1
+    finally:
+        set_policy(None)  # Main pinned the process-wide policy

@@ -170,3 +170,29 @@ def test_chaos_death_with_segments_requeues():
     for h in history:
         assert h["train"]["samples"] == \
             wf_master.loader.class_lengths[2]
+
+
+def test_pipelined_large_payloads_no_deadlock(monkeypatch):
+    """Multi-MB job/update blobs over plain TCP with pipelining: the
+    slave must drain the prefetched job reply before writing its
+    result, or both peers deadlock in write() (code-review r2). Shm is
+    disabled to force every blob through the socket."""
+    from veles_tpu.parallel import coordinator as coord
+
+    monkeypatch.setattr(coord, "_prove_same_host",
+                        lambda proto: False)
+    server = coord.CoordinatorServer(checksum="big")
+    try:
+        big = b"\x07" * (8 * 1024 * 1024)  # far beyond TCP buffers
+        server.submit(*[{"payload": big} for _ in range(4)])
+        client = coord.CoordinatorClient(server.address,
+                                         checksum="big").connect()
+        assert not client.proto._shm_tx  # everything rides the socket
+        done = client.serve_forever(
+            lambda job: {"echo": job["payload"] + b"x"}, max_idle=5)
+        assert done == 4
+        results = server.wait(4, timeout=30)
+        assert all(len(r["echo"]) == len(big) + 1 for r in results)
+    finally:
+        server.stop()
+
