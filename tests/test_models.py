@@ -140,3 +140,34 @@ def test_mnist_ae_runs_fused_through_launcher():
     assert len(history) == 3
     assert history[-1]["validation"]["normalized"] < \
         history[0]["validation"]["normalized"]
+
+
+def test_wine_sample_trains_fused():
+    """The reference's wine sample shape (13 tabular features, 3
+    classes): must reach near-zero error on the committed generator."""
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.models.samples import WineWorkflow
+    _seed()
+    launcher = Launcher(graphics=False)
+    wf = WineWorkflow(launcher, max_epochs=15)
+    launcher.initialize()
+    launcher.run()
+    assert launcher.run_mode_used == "fused"
+    best = min(h["validation"]["normalized"]
+               for h in wf.decision.epoch_history)
+    assert best <= 0.08, best
+
+
+def test_lines_sample_trains_fused():
+    """The reference's lines conv primer: 4 stroke orientations."""
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.models.samples import LinesWorkflow
+    _seed()
+    launcher = Launcher(graphics=False)
+    wf = LinesWorkflow(launcher, max_epochs=25)
+    launcher.initialize()
+    launcher.run()
+    assert launcher.run_mode_used == "fused"
+    best = min(h["validation"]["normalized"]
+               for h in wf.decision.epoch_history)
+    assert best <= 0.05, best

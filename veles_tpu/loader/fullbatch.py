@@ -122,6 +122,36 @@ class FullBatchLoader(Loader):
             self.minibatch_labels.assign_devmem(labels)
 
 
+class ProviderLoader(FullBatchLoader):
+    """Full batch over a provider callable returning
+    ``(train_x, train_y, valid_x, valid_y)`` — the one place that owns
+    the valid-before-train layout, dtype casts and class lengths
+    (MnistLoader and the sample loaders all build on it)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, provider=None, flatten=False, **kwargs):
+        super(ProviderLoader, self).__init__(workflow, **kwargs)
+        self.provider = provider
+        #: flat (n, features) for FC topologies; otherwise 3-D arrays
+        #: grow a singleton channel for NHWC conv stacks
+        self.flatten = flatten
+
+    def load_dataset(self):
+        train_x, train_y, valid_x, valid_y = self.provider()
+        data = numpy.concatenate([valid_x, train_x], axis=0).astype(
+            numpy.float32)
+        labels = numpy.concatenate([valid_y, train_y], axis=0).astype(
+            numpy.int32)
+        if self.flatten:
+            data = data.reshape(len(data), -1)
+        elif data.ndim == 3:
+            data = data[..., None]  # NHWC single channel
+        self.original_data.reset(data)
+        self.original_labels.reset(labels)
+        self.class_lengths = [0, len(valid_x), len(train_x)]
+
+
 class FullBatchLoaderMSE(FullBatchLoader):
     """Adds per-sample regression targets (``fullbatch.py:563``)."""
 

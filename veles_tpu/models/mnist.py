@@ -17,7 +17,7 @@ import struct
 import numpy
 
 from veles_tpu.accelerated_units import AcceleratedWorkflow
-from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.loader.fullbatch import ProviderLoader
 from veles_tpu.nn.all2all import All2AllSoftmax, All2AllTanh
 from veles_tpu.nn.decision import DecisionGD
 from veles_tpu.nn.evaluator import EvaluatorSoftmax
@@ -36,32 +36,17 @@ def read_idx(path):
     return data.reshape(dims)
 
 
-class MnistLoader(FullBatchLoader):
+class MnistLoader(ProviderLoader):
     """Full-batch loader over a provider callable returning
-    (train_data, train_labels, valid_data, valid_labels)."""
+    (train_data, train_labels, valid_data, valid_labels): flat
+    (n, 784) by default, (n, 28, 28, 1) NHWC with ``flatten=False``."""
 
     hide_from_registry = True
 
     def __init__(self, workflow, provider=None, flatten=True, **kwargs):
         kwargs.setdefault("normalization_type", "linear")
-        super(MnistLoader, self).__init__(workflow, **kwargs)
-        self.provider = provider
-        #: flat (n, 784) for FC topologies, (n, 28, 28, 1) NHWC for conv
-        self.flatten = flatten
-
-    def load_dataset(self):
-        train_x, train_y, valid_x, valid_y = self.provider()
-        data = numpy.concatenate([valid_x, train_x], axis=0).astype(
-            numpy.float32)
-        labels = numpy.concatenate([valid_y, train_y], axis=0).astype(
-            numpy.int32)
-        if self.flatten:
-            data = data.reshape(len(data), -1)
-        elif data.ndim == 3:
-            data = data[..., None]  # NHWC single channel
-        self.original_data.reset(data)
-        self.original_labels.reset(labels)
-        self.class_lengths = [0, len(valid_x), len(train_x)]
+        super(MnistLoader, self).__init__(workflow, provider=provider,
+                                          flatten=flatten, **kwargs)
 
 
 def mnist_idx_provider(directory):

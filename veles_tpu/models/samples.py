@@ -1,0 +1,133 @@
+"""Small sample workflows mirroring the reference's Znicz sample set
+(``.coveragerc:50-66``: wine, lines, kanji, channels — the samples the
+reference shipped beyond the BASELINE configs).
+
+The original datasets are not fetchable here (zero egress), so each
+sample pairs its topology with a committed deterministic generator of
+the same shape and difficulty class: ``wine`` (13-feature tabular,
+3 classes), ``lines`` (oriented-stroke images, 4 angle classes — the
+reference's conv primer), ``kanji``-style glyph grids reuse
+:mod:`veles_tpu.datasets`. All run fused through StandardWorkflow.
+"""
+
+import numpy
+
+from veles_tpu.loader.fullbatch import ProviderLoader
+from veles_tpu.standard_workflow import StandardWorkflow
+
+
+class WineProvider(object):
+    """Tabular 13-feature, 3-class mixture dataset (UCI wine's shape):
+    class-conditional Gaussians with overlapping covariance so a
+    linear model errs a few percent, like the original."""
+
+    def __init__(self, n_train=400, n_valid=100, seed=11):
+        self.n_train = n_train
+        self.n_valid = n_valid
+        self.seed = seed
+
+    def __call__(self):
+        rng = numpy.random.RandomState(self.seed)
+        total = self.n_train + self.n_valid
+        labels = rng.randint(0, 3, total).astype(numpy.int32)
+        centers = rng.randn(3, 13).astype(numpy.float32) * 1.5
+        mix = rng.randn(13, 13).astype(numpy.float32) * 0.4
+        data = centers[labels] + rng.randn(total, 13).astype(
+            numpy.float32) @ mix
+        return (data[:self.n_train], labels[:self.n_train],
+                data[self.n_train:], labels[self.n_train:])
+
+
+class LinesProvider(object):
+    """Oriented-stroke images, 4 classes (horizontal / vertical / the
+    two diagonals) — the shape of the reference's ``lines`` conv
+    sample."""
+
+    def __init__(self, n_train=800, n_valid=200, side=16, seed=5):
+        self.n_train = n_train
+        self.n_valid = n_valid
+        self.side = side
+        self.seed = seed
+
+    def _draw(self, rng, klass):
+        side = self.side
+        img = rng.rand(side, side).astype(numpy.float32) * 0.25
+        c = rng.randint(side // 4, 3 * side // 4)
+        span = numpy.arange(side)
+        if klass == 0:                      # horizontal
+            img[c, :] += 1.0
+        elif klass == 1:                    # vertical
+            img[:, c] += 1.0
+        elif klass == 2:                    # main diagonal
+            off = rng.randint(-side // 4, side // 4)
+            idx = numpy.clip(span + off, 0, side - 1)
+            img[span, idx] += 1.0
+        else:                               # anti-diagonal
+            off = rng.randint(-side // 4, side // 4)
+            idx = numpy.clip(side - 1 - span + off, 0, side - 1)
+            img[span, idx] += 1.0
+        return numpy.clip(img, 0.0, 1.0)
+
+    def __call__(self):
+        rng = numpy.random.RandomState(self.seed)
+        total = self.n_train + self.n_valid
+        labels = rng.randint(0, 4, total).astype(numpy.int32)
+        data = numpy.stack([self._draw(rng, int(k)) for k in labels])
+        data = data[..., None]  # NHWC
+        return (data[:self.n_train], labels[:self.n_train],
+                data[self.n_train:], labels[self.n_train:])
+
+
+class TabularLoader(ProviderLoader):
+    """Device-resident full batch over any (tx, ty, vx, vy) provider,
+    mean/dispersion-normalized by default (the wine sample's recipe)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, provider=None, **kwargs):
+        kwargs.setdefault("normalization_type", "mean_disp")
+        super(TabularLoader, self).__init__(workflow, provider=provider,
+                                            **kwargs)
+
+
+class WineWorkflow(StandardWorkflow):
+    """13 → 10 tanh → 3 softmax (the reference wine sample's shape)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, provider=None, minibatch_size=50,
+                 **kwargs):
+        provider = provider or WineProvider()
+        kwargs.setdefault("learning_rate", 0.1)
+        kwargs.setdefault("loss", "softmax")
+        super(WineWorkflow, self).__init__(
+            workflow,
+            loader=lambda w: TabularLoader(
+                w, provider=provider, minibatch_size=minibatch_size),
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 10},
+                {"type": "softmax", "output_sample_shape": 3},
+            ], **kwargs)
+
+
+class LinesWorkflow(StandardWorkflow):
+    """Small conv net over oriented strokes (reference lines sample)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, provider=None, minibatch_size=50,
+                 **kwargs):
+        provider = provider or LinesProvider()
+        kwargs.setdefault("learning_rate", 0.05)
+        kwargs.setdefault("loss", "softmax")
+        super(LinesWorkflow, self).__init__(
+            workflow,
+            loader=lambda w: TabularLoader(
+                w, provider=provider, minibatch_size=minibatch_size,
+                normalization_type="none"),
+            layers=[
+                {"type": "conv_relu", "n_kernels": 8, "kx": 3, "ky": 3},
+                {"type": "max_pooling", "kx": 2, "ky": 2},
+                {"type": "all2all_relu", "output_sample_shape": 32},
+                {"type": "softmax", "output_sample_shape": 4},
+            ], **kwargs)
