@@ -271,3 +271,16 @@ def test_precision_flag_end_to_end(workflow_file, tmp_path):
         assert abs(rmix["best_n_err_pt"] - r32["best_n_err_pt"]) <= 0.1
     finally:
         set_policy(None)  # Main pinned the process-wide policy
+
+
+def test_multihost_flags_parse_and_noop():
+    from veles_tpu.__main__ import Main
+    parser = Main().init_parser()
+    args = parser.parse_args(["wf.py", "--jax-coordinator", "h:1234",
+                              "--jax-processes", "4",
+                              "--jax-process-id", "2"])
+    assert args.jax_coordinator == "h:1234"
+    assert args.jax_processes == 4
+    from veles_tpu.parallel.mesh import init_multihost
+    assert init_multihost(num_processes=1) is False
+    assert init_multihost(num_processes=None) is False

@@ -398,3 +398,23 @@ def test_image_augmenter_rejects_oversized_crop():
     aug = ImageAugmenter(crop=(32, 32))
     with pytest.raises(ValueError, match="does not fit"):
         aug.expand(img, train=False)
+
+
+def test_image_mse_loader_paired_augmentation(image_tree):
+    """Input/target pairs must receive IDENTICAL crops/flips (image->
+    image regression trains point-to-point)."""
+    from veles_tpu.loader.image import ImageLoaderMSE
+    prng.get("loader").seed(9)
+    loader = ImageLoaderMSE(
+        DummyWorkflow(), train_paths=(str(image_tree / "train"),),
+        validation_paths=(str(image_tree / "valid"),),
+        crop=(6, 6), crop_number=2, mirror=True, minibatch_size=4)
+    _init_loader(loader)
+    # train variants multiplied: 12 imgs x 2 flips x 2 crops = 48
+    assert loader.class_lengths[TRAIN] == 48
+    assert loader.original_data.shape[1:] == (6, 6, 3)
+    # autoencoder convention: target IS the input -> identical arrays
+    # prove the pairing (same random crop applied to both)
+    numpy.testing.assert_array_equal(loader.original_data.mem,
+                                     loader.original_targets.mem)
+

@@ -268,6 +268,21 @@ class Main(Logger):
         if getattr(self.args, "precision", None):
             from veles_tpu.nn.precision import set_policy
             set_policy(self.args.precision)
+        if getattr(self.args, "jax_coordinator", None) and \
+                not getattr(self.args, "jax_processes", None):
+            # a coordinator with no process count would leave THIS host
+            # standalone while its peers block at the coordinator
+            raise SystemExit(
+                "--jax-coordinator requires --jax-processes (and "
+                "--jax-process-id) on every host")
+        if getattr(self.args, "jax_processes", None):
+            # multi-host pod: join the JAX runtime BEFORE anything
+            # touches a device; every host then sees the global mesh
+            # and the parallel trainers shard across DCN+ICI
+            from veles_tpu.parallel.mesh import init_multihost
+            init_multihost(self.args.jax_coordinator,
+                           self.args.jax_processes,
+                           self.args.jax_process_id)
         self._seed_random(self.args.seed)
         module = self._load_model(self.args.workflow)
         self._apply_config(self.args.config)

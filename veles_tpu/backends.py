@@ -122,6 +122,16 @@ class Device(Logger, metaclass=BackendRegistry):
         parser.add_argument(
             "-d", "--device", default="0",
             help="device index (for multi-chip hosts)")
+        parser.add_argument(
+            "--jax-coordinator", default=None, metavar="HOST:PORT",
+            help="multi-host pod: jax.distributed coordinator address "
+                 "(process 0's host); omit on single-host runs")
+        parser.add_argument(
+            "--jax-processes", type=int, default=None,
+            help="multi-host pod: total process (host) count")
+        parser.add_argument(
+            "--jax-process-id", type=int, default=None,
+            help="multi-host pod: this process's index")
         return parser
 
     def __repr__(self):

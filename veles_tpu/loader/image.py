@@ -84,6 +84,19 @@ class ImageAugmenter(object):
         self.mirror = mirror
         self.rand_name = rand
 
+    @classmethod
+    def pop_from_kwargs(cls, kwargs):
+        """Build from (and consume) the loader-ctor kwargs — the one
+        place the kwarg spelling lives for every image loader."""
+        augmenter = kwargs.pop("augmenter", None)
+        if augmenter is not None:
+            return augmenter
+        return cls(crop=kwargs.pop("crop", None),
+                   crop_number=kwargs.pop("crop_number", 1),
+                   scale=kwargs.pop("scale", 1.0),
+                   rotations=kwargs.pop("rotations", (0.0,)),
+                   mirror=kwargs.pop("mirror", False))
+
     def _rng(self):
         from veles_tpu import prng
         return prng.get(self.rand_name)
@@ -123,21 +136,19 @@ class ImageAugmenter(object):
                               reshape=False, mode="constant",
                               cval=0.0).astype(numpy.float32)
 
-    def expand(self, img, train):
-        """One decoded image → list of augmented variants."""
-        img = self._scaled(img)
-        cs = self._crop_shape(img.shape)
+    def _variant_params(self, shape, train):
+        """Draw the variant parameter list ``[(rot, flip, oy, ox)]``
+        for one image of (scaled) ``shape`` — separated from the pixel
+        work so input/target PAIRS can share identical draws."""
+        cs = self._crop_shape(shape)
         if not train:
-            img = self._rotated(img, 0.0)
-            if cs is not None:
-                oy = (img.shape[0] - cs[0]) // 2
-                ox = (img.shape[1] - cs[1]) // 2
-                img = self._cut(img, oy, ox, *cs)
-            return [img]
+            if cs is None:
+                return [(0.0, False, 0, 0)], cs
+            return [(0.0, False, (shape[0] - cs[0]) // 2,
+                     (shape[1] - cs[1]) // 2)], cs
         rng = self._rng()
-        out = []
+        params = []
         for rot in self.rotations:
-            base = self._rotated(img, rot)
             if self.mirror is True:
                 flips = (False, True)
             elif self.mirror == "random":
@@ -145,18 +156,39 @@ class ImageAugmenter(object):
             else:
                 flips = (False,)
             for flip in flips:
-                variant = base[:, ::-1] if flip else base
                 if cs is None:
-                    out.append(numpy.ascontiguousarray(variant))
+                    params.append((rot, flip, 0, 0))
                     continue
-                max_oy = variant.shape[0] - cs[0]
-                max_ox = variant.shape[1] - cs[1]
+                max_oy = shape[0] - cs[0]
+                max_ox = shape[1] - cs[1]
                 for _ in range(self.crop_number):
                     oy = rng.randint(max_oy + 1) if max_oy > 0 else 0
                     ox = rng.randint(max_ox + 1) if max_ox > 0 else 0
-                    out.append(numpy.ascontiguousarray(
-                        self._cut(variant, oy, ox, *cs)))
-        return out
+                    params.append((rot, flip, oy, ox))
+        return params, cs
+
+    def _apply_variant(self, img, rot, flip, oy, ox, cs):
+        out = self._rotated(img, rot)
+        if flip:
+            out = out[:, ::-1]
+        if cs is not None:
+            out = self._cut(out, oy, ox, *cs)
+        return numpy.ascontiguousarray(out)
+
+    def expand(self, img, train):
+        """One decoded image → list of augmented variants."""
+        img = self._scaled(img)
+        params, cs = self._variant_params(img.shape, train)
+        return [self._apply_variant(img, *p, cs) for p in params]
+
+    def expand_pair(self, img, target, train):
+        """Input/target pairs (image→image regression) get IDENTICAL
+        variant parameters, so crops and flips stay aligned."""
+        img = self._scaled(img)
+        target = self._scaled(target)
+        params, cs = self._variant_params(img.shape, train)
+        return ([self._apply_variant(img, *p, cs) for p in params],
+                [self._apply_variant(target, *p, cs) for p in params])
 
 
 class ImageScanner(LabeledFileScanner):
@@ -180,14 +212,7 @@ class FileImageLoader(FullBatchLoader):
         self.color_space = kwargs.pop("color_space", "RGB")
         self.filename_re = kwargs.pop("filename_re", None)
         self.ignored_dirs = kwargs.pop("ignored_dirs", ())
-        self.augmenter = kwargs.pop("augmenter", None)
-        if self.augmenter is None:
-            self.augmenter = ImageAugmenter(
-                crop=kwargs.pop("crop", None),
-                crop_number=kwargs.pop("crop_number", 1),
-                scale=kwargs.pop("scale", 1.0),
-                rotations=kwargs.pop("rotations", (0.0,)),
-                mirror=kwargs.pop("mirror", False))
+        self.augmenter = ImageAugmenter.pop_from_kwargs(kwargs)
         super(FileImageLoader, self).__init__(workflow, **kwargs)
         self.labels_mapping = {}
 
@@ -261,34 +286,57 @@ class ImageLoaderMSE(FullBatchLoaderMSE):
         self.target_paths = tuple(kwargs.pop("target_paths", ()))
         self.size = kwargs.pop("size", None)
         self.color_space = kwargs.pop("color_space", "RGB")
+        self.augmenter = ImageAugmenter.pop_from_kwargs(kwargs)
         super(ImageLoaderMSE, self).__init__(workflow, **kwargs)
 
     def load_dataset(self):
         scanner = ImageScanner()
-        data = []
-        for klass, paths in enumerate((self.test_paths,
-                                       self.validation_paths,
-                                       self.train_paths)):
+        target_pool = []
+        for base in self.target_paths:
+            target_pool.extend(scanner.scan(base))
+        per_class = []
+        total = 0
+        for paths in (self.test_paths, self.validation_paths,
+                      self.train_paths):
             pairs = []
             for base in paths:
                 pairs.extend(scanner.scan(base))
+            per_class.append(pairs)
+            total += len(pairs)
+        if target_pool and len(target_pool) != total:
+            # match-by-index needs equal counts: a silent wraparound
+            # would mispair every input after the shorter list ends
+            raise ValueError(
+                "%s: %d target images for %d inputs — the index "
+                "pairing requires equal counts" %
+                (self.name, len(target_pool), total))
+        data, targets = [], []
+        index = 0
+        for klass, pairs in enumerate(per_class):
             if pairs and self.size is None:
                 self.size = decode_image(
                     pairs[0][0], color=self.color_space).shape[:2]
-            imgs = [decode_image(p, self.size, self.color_space)
-                    for p, _ in pairs]
-            data.extend(imgs)
-            self.class_lengths[klass] = len(imgs)
+            count = 0
+            for path, _ in pairs:
+                img = decode_image(path, self.size, self.color_space)
+                # target matched to the input by index (reference
+                # image_mse convention); autoencoder convention when no
+                # target tree: the input itself
+                if target_pool:
+                    # equal counts enforced above: each target file
+                    # decodes exactly once
+                    tgt = decode_image(target_pool[index][0], self.size,
+                                       self.color_space)
+                else:
+                    tgt = img
+                index += 1
+                imgs, tgts = self.augmenter.expand_pair(
+                    img, tgt, train=klass == TRAIN)
+                data.extend(imgs)
+                targets.extend(tgts)
+                count += len(imgs)
+            self.class_lengths[klass] = count
         self.original_data.reset(numpy.stack(data).astype(numpy.float32))
         self.has_labels = False
-        targets = []
-        for base in self.target_paths:
-            targets.extend(decode_image(p, self.size, self.color_space)
-                           for p, _ in scanner.scan(base))
-        if targets:
-            self.original_targets.reset(
-                numpy.stack(targets).astype(numpy.float32))
-        else:
-            # autoencoder convention: target is the input itself
-            self.original_targets.reset(
-                numpy.array(self.original_data.mem, copy=True))
+        self.original_targets.reset(
+            numpy.stack(targets).astype(numpy.float32))
