@@ -196,3 +196,56 @@ def test_pipelined_large_payloads_no_deadlock(monkeypatch):
     finally:
         server.stop()
 
+
+
+def test_master_snapshot_resumes_distributed_training(tmp_path):
+    """Checkpoint/resume across the distributed protocol: a master
+    snapshot taken after an epoch-boundary run restarts as a new
+    master (same checksum) and a fresh slave continues training from
+    the saved weights — NOT from scratch."""
+    import numpy as np
+    from veles_tpu.snapshotter import SnapshotterToFile, dump_workflow
+
+    # phase 1: train 2 epochs distributed, snapshot the master state
+    wf1, master1 = _run_distributed(n_slaves=1, segment_size=4,
+                                    max_epochs=2)
+    snap = str(tmp_path / "master.pickle")
+    with open(snap, "wb") as f:
+        f.write(dump_workflow(wf1))
+    w_after_2 = np.asarray(wf1.gds[-1].forward.weights.map_read()).copy()
+
+    # phase 2: build the slave FIRST (its construction seeds the
+    # global PRNG registry), THEN restore — import_ reinstates the
+    # phase-1-end random streams, which must not be clobbered or the
+    # resumed shuffle order restarts from the initial seed
+    slave = Launcher(master_address="127.0.0.1:0", graphics=False)
+    _make_workflow(slave, max_epochs=4)
+    restored = SnapshotterToFile.import_(snap)
+    assert np.allclose(
+        np.asarray(restored.gds[-1].forward.weights.map_read()),
+        w_after_2)
+    restored.decision.max_epochs = 4
+    restored.decision.complete.value = False
+    master2 = Launcher(listen_address="127.0.0.1:0", graphics=False,
+                       segment_size=4)
+    restored.workflow = master2  # the setter registers with add_ref
+    master2.initialize()
+    port = master2._server.address[1]
+    slave.master_address = "127.0.0.1:%d" % port
+    slave.initialize()
+    t = threading.Thread(target=slave.run, daemon=True)
+    t.start()
+    master2.run()
+    t.join(timeout=60)
+    assert not t.is_alive()
+    history = restored.decision.epoch_history
+    # epochs 0-1 from phase 1 survive; 2-3 trained after the resume
+    assert [h["epoch"] for h in history] == [0, 1, 2, 3], history
+    # continuation, not retraining-from-scratch: the first resumed
+    # epoch starts from the phase-1 weights, so its error must stay in
+    # the phase-1-end class, far below a fresh run's epoch-0 error
+    errs = [h["validation"]["normalized"] for h in history]
+    assert errs[2] <= errs[1] + 0.08, errs
+    assert errs[2] < 0.5 * errs[0], errs
+    w_final = np.asarray(restored.gds[-1].forward.weights.map_read())
+    assert not np.allclose(w_final, w_after_2)  # training continued
