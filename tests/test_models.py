@@ -171,3 +171,21 @@ def test_lines_sample_trains_fused():
     best = min(h["validation"]["normalized"]
                for h in wf.decision.epoch_history)
     assert best <= 0.05, best
+
+
+def test_kanji_sample_smoke():
+    """Reference kanji sample shape (100-class glyph pairs): builds,
+    runs fused, emits history. Convergence (7.1% at full budget) is a
+    chip-scale run — see KanjiWorkflow's docstring."""
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.models.samples import KanjiProvider, KanjiWorkflow
+    _seed()
+    launcher = Launcher(graphics=False)
+    wf = KanjiWorkflow(launcher,
+                       provider=KanjiProvider(n_train=400, n_valid=100),
+                       max_epochs=2)
+    launcher.initialize()
+    launcher.run()
+    assert launcher.run_mode_used == "fused"
+    assert len(wf.decision.epoch_history) == 2
+    assert wf.loader.original_data.shape[1:] == (24, 48, 1)
