@@ -1,0 +1,369 @@
+"""Control-plane hardening tests (ADVICE r2 findings).
+
+The reference trusted its network completely: raw pickles over ZeroMQ
+(``veles/txzmq/connection.py:337``) and a wildcard bind
+(``veles/launcher.py:820``). These tests pin the r3 hardening: the
+restricted unpickler, the mutual HMAC handshake, the silent checksum
+check, the handshake/shm ordering, and the frame-abuse limits.
+"""
+
+import pickle
+import socket as socket_mod
+
+import numpy
+import pytest
+
+from veles_tpu.parallel import wire
+from veles_tpu.parallel.coordinator import (CoordinatorClient,
+                                            CoordinatorServer, Protocol)
+
+
+# -- restricted unpickler ----------------------------------------------------
+
+def test_wire_decode_roundtrips_control_payloads():
+    payload = {
+        "weights": numpy.arange(12, dtype=numpy.float32).reshape(3, 4),
+        "stats": [("loss", numpy.float64(0.25)), ("n", 7)],
+        "flags": {"reset_complete": True, "name": "gd"},
+        "dtype": numpy.dtype("int32"),
+        "raw": b"\x00\x01",
+    }
+    out = wire.decode(wire.encode(payload))
+    numpy.testing.assert_array_equal(out["weights"], payload["weights"])
+    assert out["stats"] == payload["stats"]
+    assert out["flags"] == payload["flags"]
+    assert out["dtype"] == payload["dtype"]
+    assert out["raw"] == payload["raw"]
+
+
+def test_wire_decode_rejects_forbidden_globals():
+    """A pickle referencing os.system (the classic RCE gadget) must be
+    refused before any reconstruction happens."""
+    import os
+    evil = wire.RAW + pickle.dumps(os.system)
+    with pytest.raises(wire.UnsafePayloadError, match="system"):
+        wire.decode(evil)
+
+
+def test_wire_decode_rejects_reduce_gadgets():
+    class Gadget(object):
+        def __reduce__(self):
+            return (print, ("pwned",))
+
+    evil = wire.RAW + pickle.dumps(Gadget())
+    with pytest.raises(wire.UnsafePayloadError):
+        wire.decode(evil)
+
+
+def test_wire_decode_trusted_escape_hatch():
+    """Blobs that never crossed a network may carry arbitrary types."""
+    blob = wire.encode({"r": range(3)})
+    assert wire.decode(blob, trusted=True)["r"] == range(3)
+
+
+# -- mutual HMAC handshake ---------------------------------------------------
+
+def test_authenticated_job_farming_roundtrip():
+    server = CoordinatorServer(checksum="c", secret="hunter2")
+    try:
+        server.submit(*[{"x": i} for i in range(4)])
+        client = CoordinatorClient(server.address, checksum="c",
+                                   secret="hunter2").connect()
+        assert client.serve_forever(lambda job: job["x"] + 1,
+                                    max_idle=3) == 4
+        assert sorted(server.wait(4, timeout=5)) == [1, 2, 3, 4]
+    finally:
+        server.stop()
+
+
+def test_secretless_client_rejected_with_guidance():
+    server = CoordinatorServer(checksum="c", secret="hunter2")
+    try:
+        with pytest.raises(ConnectionError, match="secret"):
+            CoordinatorClient(server.address, checksum="c").connect()
+        assert not server.slaves
+    finally:
+        server.stop()
+
+
+def test_wrong_secret_client_detects_rogue_master():
+    """Mutual: the master proves itself FIRST, so a client with the
+    wrong secret learns of the mismatch without ever answering."""
+    server = CoordinatorServer(checksum="c", secret="hunter2")
+    try:
+        with pytest.raises(ConnectionError, match="mutual"):
+            CoordinatorClient(server.address, checksum="c",
+                              secret="wrong").connect()
+        assert not server.slaves
+    finally:
+        server.stop()
+
+
+def test_secret_client_refuses_unauthenticated_master():
+    """Fail closed: a slave configured with a secret must never
+    downgrade when the master skips the challenge (rogue process on
+    the master's port)."""
+    server = CoordinatorServer(checksum="c")  # no secret configured
+    try:
+        with pytest.raises(ConnectionError, match="did not authenticate"):
+            CoordinatorClient(server.address, checksum="c",
+                              secret="hunter2").connect()
+    finally:
+        server.stop()
+
+
+def test_max_frame_plumbed_per_connection(monkeypatch):
+    from veles_tpu.parallel import coordinator as coord
+    # force the plain-socket path so the blob rides a frame, not shm
+    monkeypatch.setattr(coord, "_answer_same_host",
+                        lambda proto, challenge:
+                        {"cmd": "shm_proof", "proof": None})
+    big = b"z" * (2 * 1024 * 1024)
+    server = CoordinatorServer(checksum="c", max_frame=1024 * 1024)
+    try:
+        client = CoordinatorClient(server.address, checksum="c",
+                                   max_frame=4 * 1024 * 1024)
+        client.connect()
+        assert client.proto.MAX_FRAME == 4 * 1024 * 1024
+        with pytest.raises((ConnectionError, OSError)):
+            # server-side cap (1 MB) rejects the 2 MB result frame
+            client.proto.send({"cmd": "result", "data": {"b": big}})
+            client.proto.recv()
+    finally:
+        server.stop()
+
+
+def test_server_rejects_bad_proof_raw_protocol():
+    """A peer speaking the protocol by hand with a forged proof never
+    reaches the job queue."""
+    server = CoordinatorServer(checksum="c", secret="hunter2")
+    try:
+        sock = socket_mod.create_connection(server.address, timeout=5.0)
+        proto = Protocol(sock)
+        proto.send({"cmd": "handshake", "checksum": "c", "nonce": "aa"})
+        challenge = proto.recv()
+        assert "auth" in challenge
+        proto.send({"cmd": "auth", "proof": "f" * 64})
+        reply = proto.recv()
+        assert reply == {"error": "authentication failed"}
+        proto.close()
+        assert not server.slaves
+    finally:
+        server.stop()
+
+
+def test_heartbeat_channel_requires_auth():
+    server = CoordinatorServer(checksum="c", secret="hunter2")
+    try:
+        sock = socket_mod.create_connection(server.address, timeout=5.0)
+        proto = Protocol(sock)
+        proto.send({"cmd": "hb_attach", "id": "whatever", "nonce": "bb"})
+        challenge = proto.recv()
+        assert "auth" in challenge
+        proto.send({"cmd": "auth", "proof": "0" * 64})
+        assert proto.recv() == {"error": "authentication failed"}
+        proto.close()
+    finally:
+        server.stop()
+
+
+def test_checksum_mismatch_not_echoed():
+    """The expected checksum doubles as a handshake credential — a
+    mismatching peer must not be told what it should have sent."""
+    server = CoordinatorServer(checksum="top-secret-topology")
+    try:
+        sock = socket_mod.create_connection(server.address, timeout=5.0)
+        proto = Protocol(sock)
+        proto.send({"cmd": "handshake", "checksum": "WRONG",
+                    "nonce": "cc"})
+        reply = proto.recv()
+        assert "error" in reply
+        assert "top-secret-topology" not in str(reply)
+        proto.close()
+    finally:
+        server.stop()
+
+
+# -- handshake / sharedio ordering (ADVICE r2 medium) ------------------------
+
+def test_large_initial_data_survives_sharedio_handshake():
+    """initial_data >= SHM_THRESHOLD rides the handshake reply itself:
+    the server must NOT offload it to shm, because the client only
+    enables its rx side after parsing that very reply."""
+    blob = b"w" * (Protocol.SHM_THRESHOLD * 2)
+    server = CoordinatorServer(checksum="c",
+                               initial_data_source=lambda slave: blob)
+    try:
+        client = CoordinatorClient(server.address, checksum="c").connect()
+        assert client.initial_data == blob
+        # the fast path still engages for everything AFTER the handshake
+        assert client.proto._shm_tx
+        server.submit({"blob": "x" * (256 * 1024)})
+        client.serve_forever(lambda job: {"n": len(job["blob"])},
+                             max_idle=3)
+        assert server.wait(1, timeout=5) == [{"n": 256 * 1024}]
+        assert client.proto.shm_reads >= 1
+    finally:
+        server.stop()
+
+
+# -- frame abuse limits + marker collisions (ADVICE r2 low) ------------------
+
+def _protocol_pair():
+    a, b = socket_mod.socketpair()
+    return Protocol(a), Protocol(b)
+
+
+def test_marker_shaped_user_dicts_roundtrip():
+    """User payloads that coincide with wire markers must arrive
+    verbatim instead of being misread as frame/segment refs."""
+    tx, rx = _protocol_pair()
+    try:
+        for payload in (
+                {"__bin__": 3},
+                {"__shm__": "psm_x", "off": 0, "size": 4},
+                {"__esc__": {"__bin__": 0}},
+                {"outer": {"__bin__": 1}, "real": b"bytes-too"},
+                {"__esc__": b"mixed"},
+        ):
+            tx.send({"p": payload})
+            assert rx.recv() == {"p": payload}
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_marker_collision_with_sharedio_enabled():
+    tx, rx = _protocol_pair()
+    tx.enable_sharedio()
+    rx.enable_sharedio()
+    try:
+        payload = {"__shm__": "psm_evil", "size": 1 << 40}
+        tx.send({"p": payload})
+        # escaped: the receiver does NOT attach to "psm_evil"
+        assert rx.recv() == {"p": payload}
+        assert rx.shm_reads == 0
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_oversized_frame_rejected():
+    tx, rx = _protocol_pair()
+    try:
+        line = b'{"p": {"__bin__": 0}}\n'
+        tx._file.write(line)
+        tx._file.write((Protocol.MAX_FRAME + 1).to_bytes(8, "big"))
+        tx._file.flush()
+        with pytest.raises(ConnectionError, match="oversized"):
+            rx.recv()
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_total_message_cap_rejected():
+    """Many frames individually under MAX_FRAME must still trip the
+    total-bytes cap instead of buffering unbounded memory pre-auth."""
+    tx, rx = _protocol_pair()
+    rx.MAX_FRAME = 1024
+    rx.MAX_MESSAGE = 2048
+    try:
+        refs = ", ".join('"b%d": {"__bin__": %d}' % (i, i)
+                         for i in range(3))
+        tx._file.write(("{%s}\n" % refs).encode())
+        body = b"z" * 1024
+        for _ in range(3):
+            tx._file.write(len(body).to_bytes(8, "big"))
+            tx._file.write(body)
+        tx._file.flush()
+        with pytest.raises(ConnectionError, match="exceeds"):
+            rx.recv()
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_unbounded_control_line_rejected():
+    """A newline-free byte stream must trip the line cap instead of
+    buffering unboundedly in readline before auth ever runs."""
+    tx, rx = _protocol_pair()
+    rx.MAX_LINE = 4096
+    try:
+        tx._file.write(b"x" * 8192)
+        tx._file.flush()
+        with pytest.raises(ConnectionError, match="line exceeds"):
+            rx.recv()
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_non_dict_hello_answered_cleanly():
+    """A JSON array as the first message must get an error reply, not
+    kill the serve thread with an uncaught AttributeError."""
+    server = CoordinatorServer(checksum="c")
+    try:
+        sock = socket_mod.create_connection(server.address, timeout=5.0)
+        proto = Protocol(sock)
+        proto.send([1, 2, 3])
+        assert proto.recv() == {"error": "expected handshake"}
+        proto.close()
+        # the server survives and still accepts real slaves
+        client = CoordinatorClient(server.address, checksum="c").connect()
+        assert client.id
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_loopback_bind_advertised_verbatim_to_nodes(monkeypatch, tmp_path):
+    """A loopback-bound master must advertise 127.0.0.1 to --nodes
+    slaves — rewriting to gethostname() would point local slaves at an
+    external IP where nothing listens."""
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.parallel import nodes as nodes_mod
+
+    captured = {}
+
+    class FakeNodeLauncher(object):
+        def __init__(self, nodes, command, master_address=None,
+                     respawn=False):
+            captured["advertise"] = master_address
+
+        def start(self):
+            return self
+
+        def stop(self):
+            pass
+
+    monkeypatch.setattr(nodes_mod, "NodeLauncher", FakeNodeLauncher)
+    import sys
+    sys.path.insert(0, "tests")
+    from test_mnist_e2e import synthetic_digits
+    from veles_tpu.models.mnist import MnistWorkflow
+    launcher = Launcher(listen_address="127.0.0.1:0", graphics=False,
+                        nodes="localhost")
+    MnistWorkflow(launcher, provider=synthetic_digits(), layers=(8,),
+                  minibatch_size=60, max_epochs=1)
+    try:
+        launcher.initialize()
+        assert captured["advertise"][0] == "127.0.0.1"
+    finally:
+        launcher.stop()
+
+
+def test_out_of_range_bin_index_rejected():
+    tx, rx = _protocol_pair()
+    try:
+        tx._file.write(b'{"p": [{"__bin__": 0}, {"__bin__": 5}]}\n')
+        body = b"hi"
+        for _ in range(2):
+            tx._file.write(len(body).to_bytes(8, "big"))
+            tx._file.write(body)
+        tx._file.flush()
+        with pytest.raises(ConnectionError, match="range"):
+            rx.recv()
+    finally:
+        tx.close()
+        rx.close()

@@ -18,8 +18,11 @@ from veles_tpu.models.mnist import MnistWorkflow
 
 def test_parse_address():
     assert parse_address("host:123") == ("host", 123)
-    assert parse_address(":123") == ("0.0.0.0", 123)
-    assert parse_address("123") == ("0.0.0.0", 123)
+    # bare ports default to LOOPBACK (ADVICE r2: a wildcard default bind
+    # exposed the job/result protocol to the whole network)
+    assert parse_address(":123") == ("127.0.0.1", 123)
+    assert parse_address("123") == ("127.0.0.1", 123)
+    assert parse_address("0.0.0.0:123") == ("0.0.0.0", 123)  # explicit
     assert parse_address(("h", 5)) == ("h", 5)
 
 
@@ -223,6 +226,25 @@ def test_cli_config_override(workflow_file, tmp_path):
 def test_cli_dry_run_init(workflow_file):
     from veles_tpu.__main__ import main
     assert main([workflow_file, "-s", "7", "--dry-run", "init"]) == 0
+
+
+def test_cli_forwards_distributed_flags(workflow_file, tmp_path):
+    """Every distributed CLI flag must survive _launcher_kwargs — a
+    dropped --secret-file silently ran the protocol UNAUTHENTICATED
+    (found by driving the real CLI in round 3)."""
+    from veles_tpu.__main__ import Main
+    secret_path = tmp_path / "secret"
+    secret_path.write_text("s3cr3t\n")
+    m = Main()
+    code = m.run([workflow_file, "-s", "7", "--dry-run", "init",
+                  "--secret-file", str(secret_path),
+                  "--segment-size", "3", "--no-pipeline",
+                  "--max-frame-mb", "512"])
+    assert code == 0
+    assert m.launcher.secret == "s3cr3t"
+    assert m.launcher.segment_size == 3
+    assert m.launcher.pipeline is False
+    assert m.launcher.max_frame == 512 * 1024 * 1024
 
 
 def test_cli_snapshot_resume(workflow_file, tmp_path):

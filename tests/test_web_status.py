@@ -188,6 +188,20 @@ def test_workflow_and_timeline_pages_served(server):
         assert marker in body
 
 
+def test_pages_escape_untrusted_strings():
+    """Unit/event names arrive from unauthenticated POSTs and are
+    interpolated into innerHTML SVG — both pages must route every such
+    string through the shared esc() helper (ADVICE r2 stored XSS)."""
+    from veles_tpu import web_status
+    for page in (web_status._WORKFLOW_PAGE, web_status._TIMELINE_PAGE):
+        assert "function esc(" in page
+        assert "//__ESC__" not in page
+    assert "${esc(n.type)}" in web_status._WORKFLOW_PAGE
+    assert "${esc(n.name)}" in web_status._WORKFLOW_PAGE
+    assert "${esc(b.name)}" in web_status._TIMELINE_PAGE
+    assert "${esc(s.name)}" in web_status._TIMELINE_PAGE
+
+
 def test_graph_description_shape():
     import sys
     sys.path.insert(0, "tests")

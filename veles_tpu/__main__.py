@@ -157,6 +157,10 @@ class Main(Logger):
             "nodes": getattr(args, "nodes", None),
             "respawn": getattr(args, "respawn", False),
             "eager": getattr(args, "eager", False),
+            "segment_size": getattr(args, "segment_size", 8),
+            "pipeline": getattr(args, "pipeline", True),
+            "secret_file": getattr(args, "secret_file", None),
+            "max_frame_mb": getattr(args, "max_frame_mb", None),
         }
         if args.listen_address:
             kwargs["listen_address"] = args.listen_address

@@ -37,8 +37,14 @@ _encode = wire.encode
 _decode = wire.decode
 
 
-def parse_address(spec, default_host="0.0.0.0", default_port=5000):
-    """``host:port`` | ``:port`` | ``port`` → (host, port)."""
+def parse_address(spec, default_host="127.0.0.1", default_port=5000):
+    """``host:port`` | ``:port`` | ``port`` → (host, port).
+
+    The default bind is loopback — the reference listened on all
+    interfaces by default (``veles/launcher.py:820``), which combined
+    with pickled payloads is remote code execution for anyone on the
+    network. Binding wide now takes an explicit ``-l 0.0.0.0:port``
+    (pair it with ``--secret-file``)."""
     if isinstance(spec, (tuple, list)):
         return tuple(spec)
     spec = str(spec)
@@ -59,7 +65,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         "stealth", "web_status", "graphics", "slave_death_probability",
         "job_timeout", "heartbeat_timeout", "max_idle",
         "nodes", "respawn", "slave_command", "eager", "segment_size",
-        "pipeline",
+        "pipeline", "secret", "secret_file", "max_frame_mb",
     ])
 
     def __init__(self, **kwargs):
@@ -92,6 +98,20 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         #: slave: prefetch the next job while computing (async SGD,
         #: one job of weight staleness); False = strict lockstep
         self.pipeline = kwargs.get("pipeline", True)
+        #: shared secret for the coordinator's mutual HMAC handshake:
+        #: explicit kwarg > --secret-file > VELES_TPU_SECRET env
+        self.secret = kwargs.get("secret")
+        secret_file = kwargs.get("secret_file")
+        if self.secret is None and secret_file:
+            with open(secret_file) as fin:
+                self.secret = fin.read().strip()
+        if self.secret is None:
+            import os as os_mod
+            self.secret = os_mod.environ.get("VELES_TPU_SECRET") or None
+        #: per-connection binary frame cap (MB); the 256 MB default
+        #: covers AlexNet-scale weight pickles, VGG-scale needs more
+        mb = kwargs.get("max_frame_mb")
+        self.max_frame = int(mb * 1024 * 1024) if mb else None
         #: "fused" | "eager" once the standalone run path is chosen
         self.run_mode_used = None
         self.slave_command = kwargs.get("slave_command")
@@ -150,6 +170,18 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             help="minibatches per distributed job (master mode); 1 "
                  "reproduces the reference's one-minibatch-per-job "
                  "protocol")
+        parser.add_argument(
+            "--secret-file", dest="secret_file", default=None,
+            help="file holding the shared secret for the master<->slave "
+                 "HMAC handshake (VELES_TPU_SECRET env is the fallback; "
+                 "required sense: always set one when listening beyond "
+                 "loopback)")
+        parser.add_argument(
+            "--max-frame-mb", dest="max_frame_mb", type=float,
+            default=None,
+            help="master/slave: raise the per-connection binary frame "
+                 "cap (default 256 MB) for models whose pickled weight "
+                 "payload is larger")
         parser.add_argument(
             "--no-pipeline", dest="pipeline", action="store_false",
             help="slave: strict request-reply instead of prefetching "
@@ -277,13 +309,21 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             return _encode(workflow.generate_initial_data_for_slave(slave),
                            compress=not slave.sharedio)
 
+        bind = parse_address(self.listen_address)
+        if self.secret is None and bind[0] not in (
+                "127.0.0.1", "localhost", "::1"):
+            self.warning(
+                "master listening on %s WITHOUT a shared secret — any "
+                "peer that can reach the port can submit results; set "
+                "--secret-file or VELES_TPU_SECRET", bind[0])
         self._server = CoordinatorServer(
-            address=parse_address(self.listen_address),
+            address=bind,
             checksum=workflow.checksum,
             job_timeout=self.job_timeout,
             heartbeat_timeout=self.heartbeat_timeout,
             job_source=job_source, result_sink=result_sink,
-            on_drop=on_drop, initial_data_source=initial_data_source)
+            on_drop=on_drop, initial_data_source=initial_data_source,
+            secret=self.secret, max_frame=self.max_frame)
         self.info("master listening on %s:%d", *self._server.address)
         if self.nodes:
             import socket as socket_mod
@@ -294,7 +334,19 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             # address — advertise this host's name instead
             # (``veles/launcher.py:820-822``)
             host, port = self._server.address
-            if host in ("", "0.0.0.0", "::", "localhost", "127.0.0.1"):
+            if host in ("127.0.0.1", "::1"):
+                # loopback bind: advertise loopback VERBATIM — local
+                # "localhost" nodes can still dial it, and rewriting to
+                # gethostname() would point slaves at an external IP
+                # where nothing listens
+                self.warning(
+                    "--nodes with a loopback listen address: remote "
+                    "slaves cannot reach this master — pass an explicit "
+                    "-l 0.0.0.0:%d (with --secret-file) for remote "
+                    "nodes", port)
+            if host in ("", "0.0.0.0", "::"):
+                # wildcard bind: the master listens everywhere, but
+                # slaves need a concrete name to dial
                 host = socket_mod.gethostname()
             advertise = (host, port)
             command = self.slave_command or slave_command_from_argv(
@@ -310,7 +362,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             checksum=self.workflow.checksum,
             power=self.workflow.computing_power,
             death_probability=self.slave_death_probability,
-            pipeline=self.pipeline)
+            pipeline=self.pipeline, secret=self.secret,
+            max_frame=self.max_frame)
         self._client.connect()
         self.info("connected to master as slave %s", self._client.id)
         if self._client.initial_data is not None:

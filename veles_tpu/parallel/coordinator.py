@@ -62,10 +62,26 @@ class Protocol(object):
 
     #: blobs below this stay inline (shm setup isn't free)
     SHM_THRESHOLD = 64 * 1024
-    #: refuse binary frames beyond this (hostile length prefix)
-    MAX_FRAME = 1 << 31
+    #: refuse binary frames beyond this (hostile length prefix) —
+    #: 256 MiB default; raise per-instance for genuinely huge models
+    MAX_FRAME = 1 << 28
+    #: refuse messages whose binary frames sum beyond this: a single
+    #: JSON line full of placeholders must not buffer unbounded memory
+    #: before any authentication ran
+    MAX_MESSAGE = 1 << 30
+    #: cap on the JSON control line itself (readline would otherwise
+    #: buffer a newline-free stream unboundedly); generous because the
+    #: legacy path may inline sub-64KB "blob" strings in the JSON
+    MAX_LINE = 1 << 24
 
-    def __init__(self, sock):
+    def __init__(self, sock, max_frame=None):
+        if max_frame is not None:
+            # genuinely huge models (a full VGG-scale parameter pickle
+            # is >268 MB) raise the cap per-connection; the message cap
+            # scales with it
+            self.MAX_FRAME = max_frame
+            self.MAX_MESSAGE = max(4 * max_frame, Protocol.MAX_MESSAGE)
+            self.MAX_LINE = max(max_frame, Protocol.MAX_LINE)
         self.sock = sock
         self._file = sock.makefile("rwb")
         self._wlock = threading.Lock()
@@ -116,7 +132,12 @@ class Protocol(object):
         shm candidates are only *collected* here (two-pass: the segment
         must be sized for ALL of a message's blobs before writing — a
         regrow between writes would unlink bytes an earlier ref still
-        points to); the caller fills the placeholder dicts after."""
+        points to); the caller fills the placeholder dicts after.
+
+        A user dict that happens to look like one of our markers
+        (``{"__bin__": int}`` alone, or containing ``__shm__`` /
+        ``__esc__``) is wrapped in ``{"__esc__": ...}`` so the receiver
+        never mistakes payload data for a frame/segment reference."""
         if isinstance(value, bytes):
             if self._shm_tx and len(value) >= self.SHM_THRESHOLD:
                 ref = {}
@@ -134,10 +155,24 @@ class Protocol(object):
                     out[key] = ref
                 else:
                     out[key] = self._pack(item, bins, shm_items)
+            if self._collides(value):
+                return {"__esc__": out}
             return out
         if isinstance(value, (list, tuple)):
             return [self._pack(item, bins, shm_items) for item in value]
         return value
+
+    @staticmethod
+    def _collides(value):
+        """True if a raw user dict would read back as a wire marker."""
+        return ("__shm__" in value or "__esc__" in value or
+                ("__bin__" in value and len(value) == 1 and
+                 type(value["__bin__"]) is int))
+
+    @staticmethod
+    def _is_bin_marker(value):
+        return ("__bin__" in value and len(value) == 1 and
+                type(value["__bin__"]) is int)
 
     def send(self, message):
         # pack + write under the write lock: the shared segment must not
@@ -173,8 +208,14 @@ class Protocol(object):
     @classmethod
     def _count_bins(cls, value):
         if isinstance(value, dict):
-            if "__bin__" in value and len(value) == 1:
+            if cls._is_bin_marker(value):
                 return 1
+            if "__esc__" in value and len(value) == 1 and \
+                    isinstance(value["__esc__"], dict):
+                # escaped user dict: its top-level shape is data, but
+                # its values may hold genuine markers
+                return sum(cls._count_bins(v)
+                           for v in value["__esc__"].values())
             return sum(cls._count_bins(v) for v in value.values())
         if isinstance(value, list):
             return sum(cls._count_bins(v) for v in value)
@@ -182,8 +223,16 @@ class Protocol(object):
 
     def _unpack(self, value, bins):
         if isinstance(value, dict):
-            if "__bin__" in value and len(value) == 1:
-                return bins[value["__bin__"]]
+            if self._is_bin_marker(value):
+                i = value["__bin__"]
+                if not 0 <= i < len(bins):
+                    raise ConnectionError(
+                        "binary frame index %d out of range" % i)
+                return bins[i]
+            if "__esc__" in value and len(value) == 1 and \
+                    isinstance(value["__esc__"], dict):
+                return {k: self._unpack(v, bins)
+                        for k, v in value["__esc__"].items()}
             if "__shm__" in value and self._shm_rx:
                 self.shm_reads += 1
                 return self._read_shm_ref(value)
@@ -225,15 +274,28 @@ class Protocol(object):
 
     def recv(self):
         with self._rlock:
-            line = self._file.readline()
+            # bounded readline: an unauthenticated peer streaming an
+            # endless newline-free "line" must not buffer unbounded
+            # memory before json/auth ever run
+            line = self._file.readline(self.MAX_LINE + 1)
             if not line:
                 raise ConnectionError("peer closed")
+            if not line.endswith(b"\n"):
+                if len(line) > self.MAX_LINE:
+                    raise ConnectionError(
+                        "control line exceeds %d bytes" % self.MAX_LINE)
+                raise ConnectionError("peer closed mid-line")
             message = json.loads(line)
             bins = []
+            total = 0
             for _ in range(self._count_bins(message)):
                 n = int.from_bytes(self._read_exact(8), "big")
                 if n > self.MAX_FRAME:
                     raise ConnectionError("oversized frame (%d)" % n)
+                total += n
+                if total > self.MAX_MESSAGE:
+                    raise ConnectionError(
+                        "message exceeds %d bytes" % self.MAX_MESSAGE)
                 bins.append(self._read_exact(n))
         return self._unpack(message, bins)
 
@@ -361,9 +423,15 @@ class CoordinatorServer(Logger):
     def __init__(self, address=("127.0.0.1", 0), checksum="",
                  job_timeout=None, heartbeat_timeout=10.0,
                  job_source=None, result_sink=None, on_drop=None,
-                 initial_data_source=None):
+                 initial_data_source=None, secret=None, max_frame=None):
         super(CoordinatorServer, self).__init__()
         self.checksum = checksum
+        self.max_frame = max_frame
+        #: shared secret: when set, every connection (jobs AND
+        #: heartbeats) must complete a mutual HMAC challenge before any
+        #: payload is accepted — the role of nothing in the reference,
+        #: which trusted the network (``veles/server.py:484``)
+        self.secret = secret.encode() if isinstance(secret, str) else secret
         self.job_timeout = job_timeout
         self.heartbeat_timeout = heartbeat_timeout
         # dynamic mode (master/slave training): when the static queue is
@@ -384,6 +452,7 @@ class CoordinatorServer(Logger):
         self.results = []
         self.job_times = []            # history for adaptive timeout
         self._lock = threading.Lock()
+        self._results_cv = threading.Condition(self._lock)
         self._done = threading.Event()
         self._listener = socket.create_server(address)
         self.address = self._listener.getsockname()
@@ -412,16 +481,20 @@ class CoordinatorServer(Logger):
             self.jobs.extend(payloads)
 
     def wait(self, n_results, timeout=60.0):
-        """Block until ``n_results`` results arrived (or timeout)."""
+        """Block until ``n_results`` results arrived (or timeout).
+
+        Sleeps on a condition variable notified by the result path (the
+        reaper thread handles death detection independently); the 1 s
+        wake cap only bounds clock drift, not latency."""
         deadline = time.time() + timeout
-        while time.time() < deadline:
-            with self._lock:
-                self._reap_dead()
-                if len(self.results) >= n_results:
-                    return list(self.results)
-            time.sleep(0.05)
-        raise TimeoutError("only %d/%d results" %
-                           (len(self.results), n_results))
+        with self._results_cv:
+            while len(self.results) < n_results:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError("only %d/%d results" %
+                                       (len(self.results), n_results))
+                self._results_cv.wait(min(remaining, 1.0))
+            return list(self.results)
 
     def _adaptive_timeout(self):
         """max(mean + 3σ of history, job_timeout) — ``server.py:619-629``."""
@@ -477,28 +550,64 @@ class CoordinatorServer(Logger):
                 sock, _ = self._listener.accept()
             except OSError:
                 return
+            # reap finished connection threads so long-lived masters
+            # with churning slaves don't grow the list unboundedly
+            self._threads = [x for x in self._threads if x.is_alive()]
             t = threading.Thread(target=self._serve, args=(sock,),
                                  daemon=True)
             t.start()
             self._threads.append(t)
 
+    def _authenticate(self, proto, hello):
+        """Mutual HMAC challenge gating every connection when a shared
+        secret is configured.
+
+        The master proves itself FIRST (HMAC over the client's nonce)
+        so a slave never answers a rogue master's challenge, then the
+        client proves itself over the master's nonce. Without this
+        gate, anyone who can reach the port could drive the job/result
+        protocol (and pre-restricted-unpickler, execute code)."""
+        if self.secret is None:
+            return True
+        client_nonce = hello.get("nonce")
+        if not isinstance(client_nonce, str) or not client_nonce:
+            return False
+        server_nonce = secrets.token_hex(32)
+        proto.send({"auth": server_nonce,
+                    "proof": hmac.new(
+                        self.secret, ("m" + client_nonce).encode(),
+                        "sha256").hexdigest()})
+        try:
+            answer = proto.recv()
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            return False
+        expected = hmac.new(self.secret, ("s" + server_nonce).encode(),
+                            "sha256").hexdigest()
+        got = answer.get("proof") if isinstance(answer, dict) else None
+        return isinstance(got, str) and hmac.compare_digest(got, expected)
+
     def _serve(self, sock):
-        proto = Protocol(sock)
+        proto = Protocol(sock, max_frame=self.max_frame)
         sid = None
         try:
             hello = proto.recv()
+            if not isinstance(hello, dict) or \
+                    hello.get("cmd") not in ("handshake", "hb_attach"):
+                proto.send({"error": "expected handshake"})
+                return
+            if not self._authenticate(proto, hello):
+                proto.send({"error": "authentication failed"})
+                return
             if hello.get("cmd") == "hb_attach":
                 # dedicated heartbeat channel: keeps last_seen fresh even
                 # while the main channel is busy executing a long job
                 self._serve_heartbeats(proto, hello.get("id"))
                 return
-            if hello.get("cmd") != "handshake":
-                proto.send({"error": "expected handshake"})
-                return
             if hello.get("checksum") != self.checksum:
-                # reject incompatible workflow topology
-                proto.send({"error": "checksum mismatch",
-                            "expected": self.checksum})
+                # reject incompatible workflow topology; the expected
+                # value is deliberately NOT echoed (it doubles as a
+                # handshake credential for job/result access)
+                proto.send({"error": "checksum mismatch"})
                 return
             sid = str(uuid.uuid4())[:8]
             with self._lock:
@@ -516,14 +625,19 @@ class CoordinatorServer(Logger):
             sharedio = False
             if hello.get("mid") == hex(uuid.getnode()):
                 sharedio = _prove_same_host(proto)
-            if sharedio:
-                proto.enable_sharedio()
             slave_desc.sharedio = sharedio
             reply = {"id": sid, "log_id": sid, "sharedio": sharedio,
                      "mid": hex(uuid.getnode())}
             if self.initial_data_source is not None:
                 reply["data"] = self.initial_data_source(slave_desc)
             proto.send(reply)
+            if sharedio:
+                # only AFTER the handshake reply is on the wire: the
+                # client enables its rx side when it parses that reply,
+                # so a large initial_data blob must still go inline —
+                # enabling tx first would send it as a __shm__ ref the
+                # client cannot yet dereference
+                proto.enable_sharedio()
             while not self._done.is_set():
                 msg = proto.recv()
                 reply, stop = self._handle(sid, msg)
@@ -589,6 +703,7 @@ class CoordinatorServer(Logger):
                     slave.state = "WAIT"
                 if self.result_sink is None:
                     self.results.append(msg.get("data"))
+                    self._results_cv.notify_all()
                     return {"ok": True}, False
                 slave.applying = True
                 action = "sink"
@@ -663,10 +778,13 @@ class CoordinatorClient(Logger):
 
     def __init__(self, address, checksum="", power=1.0,
                  death_probability=0.0, rand="chaos",
-                 heartbeat_interval=2.0, pipeline=True):
+                 heartbeat_interval=2.0, pipeline=True, secret=None,
+                 max_frame=None):
         super(CoordinatorClient, self).__init__()
         self.address = tuple(address)
         self.checksum = checksum
+        self.secret = secret.encode() if isinstance(secret, str) else secret
+        self.max_frame = max_frame
         self.power = power
         self.death_probability = death_probability
         self.heartbeat_interval = heartbeat_interval
@@ -680,13 +798,42 @@ class CoordinatorClient(Logger):
         self.jobs_done = 0
         self._hb_stop = threading.Event()
 
+    def _answer_auth(self, proto, reply, my_nonce):
+        """Verify the master's proof over OUR nonce, then answer its
+        challenge — mutual authentication, master-first (see
+        ``CoordinatorServer._authenticate``)."""
+        if not (isinstance(reply, dict) and "auth" in reply):
+            if self.secret is not None:
+                # fail closed: a slave configured with a secret must
+                # never downgrade to an unauthenticated master (a rogue
+                # process on the master's port would otherwise feed us
+                # jobs with zero authentication)
+                raise ConnectionError(
+                    "master did not authenticate (reply: %s)"
+                    % (reply.get("error", "no auth challenge")
+                       if isinstance(reply, dict) else "malformed"))
+            return reply
+        if self.secret is None:
+            raise ConnectionError(
+                "master requires a shared secret (--secret-file)")
+        expected = hmac.new(self.secret, ("m" + my_nonce).encode(),
+                            "sha256").hexdigest()
+        if not (isinstance(reply.get("proof"), str) and
+                hmac.compare_digest(reply["proof"], expected)):
+            raise ConnectionError("master failed mutual authentication")
+        proto.send({"cmd": "auth", "proof": hmac.new(
+            self.secret, ("s" + str(reply["auth"])).encode(),
+            "sha256").hexdigest()})
+        return proto.recv()
+
     def connect(self):
         sock = socket.create_connection(self.address, timeout=10.0)
-        self.proto = Protocol(sock)
+        self.proto = Protocol(sock, max_frame=self.max_frame)
+        nonce = secrets.token_hex(32)
         self.proto.send({"cmd": "handshake", "checksum": self.checksum,
-                         "power": self.power,
+                         "power": self.power, "nonce": nonce,
                          "mid": hex(uuid.getnode()), "pid": os.getpid()})
-        reply = self.proto.recv()
+        reply = self._answer_auth(self.proto, self.proto.recv(), nonce)
         if isinstance(reply, dict) and "shm_challenge" in reply:
             # master asks for proof we really share its machine (see
             # _prove_same_host); answer and read the actual handshake
@@ -704,9 +851,11 @@ class CoordinatorClient(Logger):
         # dedicated heartbeat channel so long handler() runs don't get
         # this slave declared dead mid-job
         hb_sock = socket.create_connection(self.address, timeout=10.0)
-        self._hb_proto = Protocol(hb_sock)
-        self._hb_proto.send({"cmd": "hb_attach", "id": self.id})
-        self._hb_proto.recv()
+        self._hb_proto = Protocol(hb_sock, max_frame=self.max_frame)
+        hb_nonce = secrets.token_hex(32)
+        self._hb_proto.send({"cmd": "hb_attach", "id": self.id,
+                             "nonce": hb_nonce})
+        self._answer_auth(self._hb_proto, self._hb_proto.recv(), hb_nonce)
         t = threading.Thread(target=self._hb_loop, daemon=True,
                              name="slave-heartbeat-%s" % self.id)
         t.start()

@@ -11,9 +11,19 @@ Same-host peers skip compression (the shm fast path moves bytes at
 memory speed; zlib would only burn CPU). Cross-host blobs compress
 with zlib level 1 — weight deltas are float arrays where even fast
 compression wins back far more wire time than it costs.
+
+Decoding is **restricted by default**: control-plane payloads are
+numpy arrays plus JSON-shaped primitives, so :func:`decode` refuses to
+reconstruct any other class. The reference trusted raw pickles from
+the network (``veles/txzmq/connection.py:337``, arbitrary-code
+execution for anyone who could reach the port); here a hostile blob
+raises :class:`UnsafePayloadError` instead of importing attacker-chosen
+callables. Pass ``trusted=True`` only for blobs that never crossed a
+network boundary.
 """
 
 import pickle
+import io
 import zlib
 
 RAW = b"\x00"
@@ -21,6 +31,48 @@ ZLIB = b"\x01"
 
 #: don't compress blobs smaller than this (codec overhead dominates)
 MIN_COMPRESS = 4 * 1024
+
+
+class UnsafePayloadError(pickle.UnpicklingError):
+    """A network payload referenced a class outside the allowlist."""
+
+
+#: (module, qualname) pairs a control-plane payload may reconstruct.
+#: numpy 2 pickles through ``numpy._core``; peers on numpy 1.x emit
+#: ``numpy.core`` — both spellings are the same two functions.
+SAFE_GLOBALS = {
+    ("builtins", "complex"),
+    ("builtins", "bytearray"),
+    ("builtins", "set"),
+    ("builtins", "frozenset"),
+    ("builtins", "slice"),
+    ("builtins", "range"),
+    ("collections", "OrderedDict"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+}
+
+
+class RestrictedUnpickler(pickle.Unpickler):
+    """Allowlist unpickler: numpy + basic containers, nothing else."""
+
+    def find_class(self, module, name):
+        if (module, name) in SAFE_GLOBALS or (
+                # numpy 2 moved dtype classes to numpy.dtypes
+                # (Float32DType etc.) — plain data, no code execution
+                module == "numpy.dtypes" and name.endswith("DType")):
+            return super(RestrictedUnpickler, self).find_class(
+                module, name)
+        raise UnsafePayloadError(
+            "payload references forbidden global %s.%s" % (module, name))
+
+
+def _restricted_loads(payload):
+    return RestrictedUnpickler(io.BytesIO(payload)).load()
 
 
 def encode(obj, compress=True):
@@ -33,8 +85,8 @@ def encode(obj, compress=True):
     return RAW + payload
 
 
-def decode(blob):
-    """Tagged bytes -> object."""
+def decode(blob, trusted=False):
+    """Tagged bytes -> object (allowlist-unpickled unless ``trusted``)."""
     if isinstance(blob, str):
         # a peer that fell back to text framing (or a shm segment read
         # as text) delivers latin-1; recover the raw bytes
@@ -44,4 +96,6 @@ def decode(blob):
         payload = zlib.decompress(payload)
     elif tag != RAW:
         raise ValueError("unknown wire codec tag %r" % tag)
-    return pickle.loads(payload)
+    if trusted:
+        return pickle.loads(payload)
+    return _restricted_loads(payload)

@@ -193,6 +193,15 @@ load();
 </script></body></html>"""
 
 
+#: shared HTML-escaping helper for every inline page that builds markup
+#: via innerHTML from unauthenticated POST data (one definition so a
+#: future hardening fix cannot miss a copy)
+_ESC_JS = """function esc(s) {
+  return String(s).replace(/[&<>"']/g, c => ({"&": "&amp;",
+    "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"}[c]));
+}"""
+
+
 _WORKFLOW_PAGE = """<!DOCTYPE html>
 <html><head><title>veles_tpu workflow graph</title><style>
 body { font-family: sans-serif; margin: 2em; background: #fafafa; }
@@ -245,6 +254,7 @@ function layout(nodes, edges) {
   });
   return pos;
 }
+//__ESC__
 function render(graph) {
   const pos = layout(graph.nodes, graph.edges);
   const w = Math.max(...[...pos.values()].map(p => p.x)) + 200;
@@ -262,11 +272,12 @@ function render(graph) {
   }
   for (const n of graph.nodes) {
     const p = pos.get(n.id);
-    svg += `<g class="node ${n.group || ""}"
+    svg += `<g class="node ${esc(n.group || "")}"
       transform="translate(${p.x},${p.y})">
       <rect width="130" height="36"/>
-      <text x="65" y="15" text-anchor="middle">${n.type}</text>
-      <text x="65" y="29" text-anchor="middle" fill="#555">${n.name}</text>
+      <text x="65" y="15" text-anchor="middle">${esc(n.type)}</text>
+      <text x="65" y="29" text-anchor="middle"
+        fill="#555">${esc(n.name)}</text>
       </g>`;
   }
   document.getElementById("view").innerHTML = svg + "</svg>";
@@ -309,6 +320,7 @@ line.single { stroke: #b14d4d; stroke-width: 2; }
 Rickshaw logs view); newest 60s window, refreshed live.</p>
 <div id="view"></div>
 <script>
+//__ESC__
 async function refresh() {
   const resp = await fetch("/service", {method: "POST",
     headers: {"Content-Type": "application/json"},
@@ -345,7 +357,7 @@ async function refresh() {
   let svg = `<svg width="${W}" height="${H}">`;
   for (const [inst, lane] of lanes) {
     svg += `<text x="4" y="${30 + lane * laneH + 12}">` +
-      inst.split("@")[0].slice(0, 30) + `</text>`;
+      esc(inst.split("@")[0].slice(0, 30)) + `</text>`;
     svg += `<line x1="${left}" y1="${30 + lane * laneH + laneH - 2}"
       x2="${W - 10}" y2="${30 + lane * laneH + laneH - 2}"
       stroke="#eee"/>`;
@@ -355,14 +367,14 @@ async function refresh() {
     const x0 = x(Math.max(b.t0, tmin));
     svg += `<rect class="bar" x="${x0}" y="${30 + b.lane * laneH + 2}"
       width="${Math.max(x(b.t1) - x0, 1.5)}" height="${laneH - 6}">
-      <title>${b.name}: ${((b.t1 - b.t0) * 1000).toFixed(1)}ms</title>
+      <title>${esc(b.name)}: ${((b.t1 - b.t0) * 1000).toFixed(1)}ms</title>
       </rect>`;
   }
   for (const s of singles) {
     if (s.t < tmin) continue;
     svg += `<line class="single" x1="${x(s.t)}" x2="${x(s.t)}"
       y1="${30 + s.lane * laneH + 2}" y2="${30 + s.lane * laneH + laneH - 4}">
-      <title>${s.name}</title></line>`;
+      <title>${esc(s.name)}</title></line>`;
   }
   svg += `<text x="${left}" y="16">${new Date(tmin * 1000)
     .toISOString()}</text>
@@ -372,6 +384,9 @@ async function refresh() {
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
+
+_WORKFLOW_PAGE = _WORKFLOW_PAGE.replace("//__ESC__", _ESC_JS)
+_TIMELINE_PAGE = _TIMELINE_PAGE.replace("//__ESC__", _ESC_JS)
 
 
 def _match(record, query):
