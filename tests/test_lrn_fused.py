@@ -1,0 +1,84 @@
+"""Fused Pallas LRN parity (VERDICT r2 item #1): the TPU kernel pair
+must match the XLA slices formulation exactly — forward AND the
+custom_vjp backward with its recomputed denominator — across shapes,
+window widths and the non-AlexNet beta (exp/log fallback path).
+
+Runs the kernels in Pallas interpreter mode on the CPU test mesh; the
+real-chip timing lives in scripts/lrn_bench.py + docs/PERF.md.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.nn.normalization import _lrn_slices, lrn
+from veles_tpu.ops.lrn import lrn_fused
+
+RNG = numpy.random.RandomState(7)
+
+SHAPES = [(4, 7, 7, 96), (2, 5, 5, 256), (3, 9, 9, 64), (2, 3, 3, 32)]
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_forward_matches_slices(shape):
+    x = jnp.asarray(RNG.randn(*shape).astype("f"))
+    got = lrn_fused(x, 2.0, 1e-4, 0.75, 5, True)
+    want = _lrn_slices(x)
+    numpy.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_backward_matches_slices(shape):
+    x = jnp.asarray(RNG.randn(*shape).astype("f"))
+    g = jnp.asarray(RNG.randn(*shape).astype("f"))
+    _, vjp_ref = jax.vjp(_lrn_slices, x)
+    _, vjp_pal = jax.vjp(
+        lambda v: lrn_fused(v, 2.0, 1e-4, 0.75, 5, True), x)
+    (want,), (got,) = vjp_ref(g), vjp_pal(g)
+    numpy.testing.assert_allclose(got, want, atol=2e-6)
+
+
+def test_generic_beta_and_window():
+    """beta != 3/4 exercises the exp/log path; n=3 the window loop."""
+    x = jnp.asarray(RNG.randn(2, 4, 4, 48).astype("f") * 2)
+    g = jnp.asarray(RNG.randn(2, 4, 4, 48).astype("f"))
+    kw = dict(k=1.0, alpha=2e-4, beta=0.5, n=3)
+    _, vjp_ref = jax.vjp(lambda v: _lrn_slices(v, **kw), x)
+    _, vjp_pal = jax.vjp(
+        lambda v: lrn_fused(v, 1.0, 2e-4, 0.5, 3, True), x)
+    numpy.testing.assert_allclose(
+        lrn_fused(x, 1.0, 2e-4, 0.5, 3, True),
+        _lrn_slices(x, **kw), atol=1e-6)
+    numpy.testing.assert_allclose(vjp_pal(g)[0], vjp_ref(g)[0],
+                                  atol=2e-6)
+
+
+def test_bfloat16_in_kernel_f32_math():
+    """bf16 tensors halve HBM traffic; the window math runs f32 inside
+    VMEM, so the result must match the f32 computation to bf16 eps."""
+    xf = RNG.randn(2, 6, 6, 96).astype("f")
+    x16 = jnp.asarray(xf, dtype=jnp.bfloat16)
+    got = lrn_fused(x16, 2.0, 1e-4, 0.75, 5, True)
+    assert got.dtype == jnp.bfloat16
+    want = _lrn_slices(jnp.asarray(xf))
+    numpy.testing.assert_allclose(
+        got.astype(jnp.float32), want, atol=2e-2, rtol=2e-2)
+
+
+def test_even_window_rejected_by_kernel_and_dispatched_to_slices():
+    """The kernel's window is symmetric, so even n (where _lrn_slices
+    sums exactly n taps, asymmetrically) must NOT silently reach it."""
+    x = jnp.asarray(RNG.randn(2, 3, 3, 16).astype("f"))
+    with pytest.raises(ValueError, match="odd"):
+        lrn_fused(x, 2.0, 1e-4, 0.75, 4, True)
+    # the public entry point quietly keeps even n on the XLA path
+    numpy.testing.assert_allclose(lrn(x, n=4), _lrn_slices(x, n=4),
+                                  atol=0)
+
+
+def test_dispatch_stays_on_slices_off_tpu():
+    """On the CPU test mesh lrn() must keep the XLA formulation (the
+    Pallas kernels would need interpret mode there)."""
+    x = jnp.asarray(RNG.randn(2, 3, 3, 16).astype("f"))
+    numpy.testing.assert_allclose(lrn(x), _lrn_slices(x), atol=0)

@@ -104,7 +104,10 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         secret_file = kwargs.get("secret_file")
         if self.secret is None and secret_file:
             with open(secret_file) as fin:
-                self.secret = fin.read().strip()
+                # empty/whitespace file must NOT become secret="" (that
+                # would "authenticate" with a zero-entropy key while
+                # suppressing the no-secret warning)
+                self.secret = fin.read().strip() or None
         if self.secret is None:
             import os as os_mod
             self.secret = os_mod.environ.get("VELES_TPU_SECRET") or None

@@ -46,6 +46,13 @@ class DecisionBase(Unit, IResultProvider):
                     "epoch_number", "class_lengths", "minibatch_size")
 
     def initialize(self, **kwargs):
+        if getattr(self, "_restored_from_snapshot_", False):
+            # mid-epoch snapshot resume: the partial epoch sums the
+            # eager path accumulated per minibatch must survive — the
+            # remaining minibatches complete them to the uninterrupted
+            # totals (both schedulers rely on this)
+            self._restored_from_snapshot_ = False
+            return
         self._reset_epoch()
 
     def _reset_epoch(self):

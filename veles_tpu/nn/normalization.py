@@ -1,5 +1,15 @@
 """Local response normalization (Znicz normalization.py — the AlexNet
-cross-channel LRN). Pure function, so the generic vjp backward applies.
+cross-channel LRN).
+
+Two formulations:
+
+* **XLA slices** (the default): n shifted slices — n is tiny, XLA
+  fuses them into the surrounding graph, and the generic vjp applies.
+* **fused Pallas forward+backward** (:mod:`veles_tpu.ops.lrn`,
+  ``VELES_LRN=pallas``): window sums as a banded matmul on the MXU,
+  the vjp's only residual is ``x`` (denominator recomputed in VMEM).
+  Kept as a measured NEGATIVE result: parity in isolation, −22%
+  end-to-end because the opaque kernel blocks fusion (docs/PERF.md).
 """
 
 import jax
@@ -8,11 +18,9 @@ import jax.numpy as jnp
 from veles_tpu.nn.base import ForwardBase
 
 
-def lrn(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
-    """Cross-channel LRN over NHWC: AlexNet formula.
-
-    The channel-window sum is n shifted slices (n is tiny, XLA fuses
-    them) — generic-reducer reduce_window has no autodiff rule."""
+def _lrn_slices(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
+    """XLA formulation: the channel-window sum as n shifted slices
+    (generic-reducer reduce_window has no autodiff rule)."""
     sq = jnp.square(x)
     half = n // 2
     padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
@@ -21,6 +29,25 @@ def lrn(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
         jax.lax.slice_in_dim(padded, i, i + channels, axis=x.ndim - 1)
         for i in range(n))
     return x / jnp.power(k + alpha * window, beta)
+
+
+def lrn(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
+    """Cross-channel LRN over NHWC: AlexNet formula.
+
+    The default stays on the XLA slices formulation EVERYWHERE — a
+    measured decision, not a shortcut: the Pallas custom_vjp pair
+    (:mod:`veles_tpu.ops.lrn`) reaches parity on isolated shapes but
+    LOSES 22% end-to-end in the AlexNet fused step (9,660 -> 7,526
+    samples/s, docs/PERF.md r3 ablation), because an opaque kernel cuts
+    the fusion graph XLA otherwise builds around the LRN. Set
+    ``VELES_LRN=pallas`` to re-run that ablation."""
+    import os
+    force = os.environ.get("VELES_LRN", "xla")
+    on_tpu = jax.default_backend() == "tpu"
+    if x.ndim == 4 and n % 2 == 1 and force == "pallas":
+        from veles_tpu.ops.lrn import lrn_fused
+        return lrn_fused(x, k, alpha, beta, n, interpret=not on_tpu)
+    return _lrn_slices(x, k, alpha, beta, n)
 
 
 class LRNormalizerForward(ForwardBase):

@@ -1,0 +1,146 @@
+"""Fused Pallas LRN forward + backward (VERDICT r2 item #1).
+
+AlexNet's cross-channel LRN (``veles/znicz reference: normalization``)
+is the one hot op XLA handles worst on TPU: the padded-square window
+sums of the forward AND of its vjp are materialized to HBM, and the
+activations they touch (55^2x96 / 27^2x256 per sample) make LRN ~31%
+of the f32 AlexNet step (docs/PERF.md). This module owns the op the
+way the reference owned its OpenCL kernels
+(``veles/accelerated_units.py:298-309``):
+
+* **forward**: one Pallas kernel — read x, write y, window sums live
+  in VMEM (circular lane rolls + boundary masks, never HBM);
+* **backward**: one Pallas kernel via ``jax.custom_vjp`` whose only
+  residual is ``x`` itself — the denominator is *recomputed in VMEM*
+  (recompute-in-backward), so the traffic is the floor: read x and g,
+  write dx, one pass;
+* beta = 3/4 (the AlexNet constant) uses an rsqrt chain
+  (``d^-3/4 = rsqrt(d)^2 * rsqrt(rsqrt(d))``) instead of exp/log —
+  in-kernel this is pure VPU work, unlike the XLA-level rsqrt
+  decomposition which spilled passes (docs/PERF.md:48-50).
+
+The math:  y_c = x_c * d_c^-beta,  d_c = k + alpha * W(x^2)_c  with
+W the n-wide channel window. The vjp needs ONE more window sum:
+dx = g * d^-beta - 2*alpha*beta * x * W(g * x * d^(-beta-1)).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _band(channels, n):
+    """(C, C) 0/1 band: entry (i, j) = |i - j| <= n // 2."""
+    row = jax.lax.broadcasted_iota(jnp.int32, (channels, channels), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (channels, channels), 1)
+    return (jnp.abs(row - col) <= n // 2).astype(jnp.float32)
+
+
+def _window_sum(v, n):
+    """Sliding window sum along the last (lane) axis, width ``n``
+    centered — as a BANDED MATMUL on the otherwise-idle MXU.
+
+    Cross-lane rolls are VPU shuffles that dominated the kernel
+    (measured: roll+mask lost to XLA at C=96); ``v @ band`` moves the
+    same reduction to the systolic array where it is noise-level FLOPs,
+    and the band's zero corners give the boundary masking for free.
+    HIGHEST precision keeps the f32 window sums exact (the MXU's
+    default f32 path rounds through bf16 passes)."""
+    return jnp.dot(v, _band(v.shape[-1], n),
+                   precision=jax.lax.Precision.HIGHEST,
+                   preferred_element_type=jnp.float32)
+
+
+def _neg_pow(d, beta):
+    """d^-beta on the VPU: rsqrt chain for the AlexNet beta=3/4."""
+    if abs(beta - 0.75) < 1e-12:
+        s = jax.lax.rsqrt(d)        # d^-1/2
+        return s * s * jax.lax.rsqrt(s)   # d^-1 * d^1/4 = d^-3/4
+    return jnp.exp(-beta * jnp.log(d))
+
+
+def _fwd_kernel(x_ref, y_ref, *, k, alpha, beta, n):
+    x = x_ref[...].astype(jnp.float32)
+    d = k + alpha * _window_sum(x * x, n)
+    y_ref[...] = (x * _neg_pow(d, beta)).astype(y_ref.dtype)
+
+
+def _bwd_kernel(x_ref, g_ref, dx_ref, *, k, alpha, beta, n):
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    d = k + alpha * _window_sum(x * x, n)   # recompute: VMEM, not HBM
+    q = _neg_pow(d, beta)                   # d^-beta
+    u = _window_sum(g * x * (q / d), n)     # W(g x d^(-beta-1))
+    dx_ref[...] = (g * q - (2.0 * alpha * beta) * x * u).astype(
+        dx_ref.dtype)
+
+
+#: rows per grid step. The window never crosses rows (channels-only),
+#: so ANY row tiling is halo-free; 512 rows keep the kernel's f32
+#: working set well under the 16 MB scoped-VMEM budget even at C=256
+#: (a per-sample 55x55x96 block + temporaries blew it)
+_BLOCK_ROWS = 512
+
+
+def _row_view(x):
+    """(..., C) -> (R, C): layout-preserving, XLA folds it away."""
+    return x.reshape(-1, x.shape[-1])
+
+
+def _row_spec(channels):
+    return pl.BlockSpec((_BLOCK_ROWS, channels), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _call_fwd(x, k, alpha, beta, n, interpret):
+    rows = _row_view(x)
+    spec = _row_spec(rows.shape[-1])
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, k=k, alpha=alpha, beta=beta, n=n),
+        out_shape=jax.ShapeDtypeStruct(rows.shape, x.dtype),
+        grid=(pl.cdiv(rows.shape[0], _BLOCK_ROWS),),
+        in_specs=[spec], out_specs=spec,
+        interpret=interpret,
+    )(rows)
+    return out.reshape(x.shape)
+
+
+def _call_bwd(x, g, k, alpha, beta, n, interpret):
+    rows, grows = _row_view(x), _row_view(g)
+    spec = _row_spec(rows.shape[-1])
+    out = pl.pallas_call(
+        functools.partial(_bwd_kernel, k=k, alpha=alpha, beta=beta, n=n),
+        out_shape=jax.ShapeDtypeStruct(rows.shape, x.dtype),
+        grid=(pl.cdiv(rows.shape[0], _BLOCK_ROWS),),
+        in_specs=[spec, spec], out_specs=spec,
+        interpret=interpret,
+    )(rows, grows)
+    return out.reshape(x.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def lrn_fused(x, k=2.0, alpha=1e-4, beta=0.75, n=5, interpret=False):
+    """Fused-LRN entry point: NHWC (or any layout with channels last,
+    rank >= 2, batch leading). ``n`` must be odd: the kernel's window
+    is symmetric (and the backward's self-adjoint-window identity
+    relies on that) — even ``n`` takes the XLA slices path."""
+    if n % 2 == 0:
+        raise ValueError("lrn_fused requires an odd window (n=%d)" % n)
+    return _call_fwd(x, k, alpha, beta, n, interpret)
+
+
+def _fwd_rule(x, k, alpha, beta, n, interpret):
+    # residual is x ALONE — the whole point: the denominator is
+    # recomputed in VMEM by the backward kernel instead of being
+    # saved to (and re-read from) HBM
+    return _call_fwd(x, k, alpha, beta, n, interpret), x
+
+
+def _bwd_rule(k, alpha, beta, n, interpret, x, g):
+    return (_call_bwd(x, g, k, alpha, beta, n, interpret),)
+
+
+lrn_fused.defvjp(_fwd_rule, _bwd_rule)

@@ -82,7 +82,20 @@ def fused_compatible(workflow):
         return "loader dataset is not device-resident"
     offset = getattr(loader, "_global_offset", 0)
     if 0 < offset < loader.total_samples:
-        return "loader resumed mid-epoch (offset %d)" % offset
+        # a mid-epoch snapshot resume runs the REMAINING minibatches
+        # through the same scan (_resume_partial_epoch) — fused stays
+        # the production path. Only two genuinely nonstandard states
+        # still need the eager scheduler:
+        if getattr(loader, "failed_minibatches", None):
+            return "loader has requeued minibatches pending"
+        ends = loader.class_end_offsets
+        for klass, end in enumerate(ends):
+            if offset < end and loader.class_lengths[klass]:
+                within = offset - (end - loader.class_lengths[klass])
+                if within % loader.max_minibatch_size != 0:
+                    return ("resume offset %d is not minibatch-aligned"
+                            % offset)
+                break
     covered = _covered_units(workflow)
     for unit in workflow:
         if unit in covered:
@@ -108,34 +121,47 @@ class FusedRunner(Logger):
 
     # -- epoch bodies ------------------------------------------------------
 
-    def _eval_classes(self, params, testing):
+    def _eval_classes(self, params, testing, skips=None):
         """Forward-only passes in the eager serving order. When the
         evaluator computes a confusion matrix, it rides along in the
-        same scan — no second forward sweep."""
+        same scan — no second forward sweep.
+
+        ``skips`` (mid-epoch snapshot resume) maps class -> samples
+        already served pre-snapshot; ``None`` = fully served, skip the
+        class entirely."""
         trainer = self.trainer
         loader = trainer.loader
         evaluator = self.workflow.evaluator
+        skips = skips or {}
         stats = {}
         klasses = (TEST, VALIDATION, TRAIN) if testing \
             else (TEST, VALIDATION)
         for klass in klasses:
-            if not loader.class_lengths[klass]:
+            skip = skips.get(klass, 0)
+            if not loader.class_lengths[klass] or skip is None:
                 continue
-            losses, metrics, conf = trainer.eval_class(params, klass)
-            if conf is not None:
+            losses, metrics, conf = trainer.eval_class(params, klass,
+                                                       skip=skip)
+            if conf is not None and skip == 0:
                 # later classes overwrite: confusion ends up for the
-                # most meaningful class evaluated (validation over test)
+                # most meaningful class evaluated (validation over
+                # test); a partial (resumed) sweep would understate it
                 evaluator.confusion_matrix = numpy.asarray(conf)
             stats[klass] = trainer._summarize(losses, metrics, klass)
+            if skip:
+                stats[klass]["samples"] -= skip
             self._last_batch = (float(losses[-1]), float(metrics[-1]))
         return stats
 
-    def _train_class(self, params, states):
+    def _train_class(self, params, states, skip=0):
         trainer = self.trainer
         params, states, losses, metrics = trainer.train_class(
-            params, states)
+            params, states, skip=skip)
         self._last_batch = (float(losses[-1]), float(metrics[-1]))
-        return params, states, trainer._summarize(losses, metrics, TRAIN)
+        stats = trainer._summarize(losses, metrics, TRAIN)
+        if skip:
+            stats["samples"] -= skip
+        return params, states, stats
 
     # -- epoch-boundary side effects ---------------------------------------
 
@@ -144,15 +170,20 @@ class FusedRunner(Logger):
 
         Same calls the eager path makes (decision.py run():82-88), so
         epoch_history entries, improved/best_* state, stop decisions and
-        log lines are identical between the two schedulers."""
+        log lines are identical between the two schedulers.
+
+        Stats ACCUMULATE into the decision's epoch buckets: for a fresh
+        epoch the buckets are zero (``_reset_epoch``) so this equals
+        assignment, and for a mid-epoch snapshot resume the snapshot's
+        partial sums complete to exactly the uninterrupted totals."""
         decision = self.workflow.decision
         loader = self.workflow.loader
         for klass in (TEST, VALIDATION, TRAIN):
             if klass not in stats:
                 continue
             epoch_stats = decision.epoch_stats[klass]
-            epoch_stats["samples"] = stats[klass]["samples"]
-            epoch_stats["metric"] = stats[klass]["metric"]
+            epoch_stats["samples"] += stats[klass]["samples"]
+            epoch_stats["metric"] += stats[klass]["metric"]
             decision._on_class_finished(klass)
         loader.samples_served += sum(
             s["samples"] for s in stats.values())
@@ -202,6 +233,50 @@ class FusedRunner(Logger):
             for nxt in dst.links_to:
                 signals.append((nxt, dst))
 
+    def _resume_partial_epoch(self, params, states, offset,
+                              confusion_from_train=False):
+        """Finish the epoch a mid-epoch snapshot interrupted — fused.
+
+        The snapshot froze the loader at ``offset`` with the epoch's
+        ``shuffled_indices`` intact and the decision's partial epoch
+        sums in place (eager accumulates per minibatch). Serving the
+        REMAINING samples of each class through the same compiled
+        segments and letting ``_close_epoch`` accumulate reproduces the
+        uninterrupted run exactly (``veles/snapshotter.py:387-409`` +
+        ``veles/loader/base.py:880`` semantics on the fused path).
+        """
+        trainer = self.trainer
+        loader = trainer.loader
+        decision = self.workflow.decision
+        testing = bool(decision.testing)
+        ends = loader.class_end_offsets
+        # per-class samples already served pre-snapshot; None = the
+        # whole class was served (its _on_class_finished fired then)
+        skips = {}
+        for klass in (TEST, VALIDATION, TRAIN):
+            length = loader.class_lengths[klass]
+            if not length:
+                continue
+            skips[klass] = None if offset >= ends[klass] else \
+                max(offset - (ends[klass] - length), 0)
+        stats = self._eval_classes(params, testing, skips=skips)
+        train_skip = skips.get(TRAIN)
+        if not testing and train_skip is not None and \
+                loader.class_lengths[TRAIN]:
+            params, states, train_stats = self._train_class(
+                params, states, skip=train_skip)
+            stats[TRAIN] = train_stats
+        if confusion_from_train and not testing:
+            # the normal epoch loop refreshes the plotters' confusion
+            # before closing; the resumed epoch must too, or they render
+            # the snapshot's stale matrix
+            self._feed_confusion_from_train(params)
+        self.info("resumed mid-epoch snapshot at offset %d: served the "
+                  "remaining %d samples fused", offset,
+                  sum(s["samples"] for s in stats.values()))
+        self._close_epoch(stats)
+        return params, states, stats
+
     def _feed_confusion_from_train(self, params):
         """No validation set: confusion comes from a forward sweep of
         the TRAIN class (eval segments never see it outside testing
@@ -241,6 +316,17 @@ class FusedRunner(Logger):
         params = states = None
         try:
             params, states = trainer.pull_params()
+            offset = getattr(loader, "_global_offset", 0)
+            if 0 < offset < loader.total_samples and not (
+                    bool(decision.complete) or bool(workflow.stopped)):
+                params, states, stats = self._resume_partial_epoch(
+                    params, states, offset,
+                    confusion_from_train=confusion_from_train)
+                if services:
+                    trainer.push_params(params, states)
+                self._fire_services(services)
+                epochs_done += 1
+                samples_done += sum(s["samples"] for s in stats.values())
             while True:
                 if bool(decision.complete) or bool(workflow.stopped):
                     # e.g. a resumed snapshot of a finished run: the

@@ -275,22 +275,27 @@ class FusedTrainer(Logger):
 
     # -- class-level driving (shared by run_epoch and FusedRunner) ---------
 
-    def eval_class(self, params, klass):
-        """Forward-only sweep of one class.
+    def eval_class(self, params, klass, skip=0):
+        """Forward-only sweep of one class (from sample ``skip`` on).
 
         Returns ``(losses, metrics, confusion)`` where ``confusion`` is
         None unless it rides the eval scan (``wants_confusion``)."""
-        idx = self._segment_indices(klass)
+        idx = self._segment_indices(klass, skip=skip)
         out = self._eval_segment(params, jnp.asarray(idx))
         return out[0], out[1], out[2] if len(out) == 3 else None
 
-    def train_class(self, params, states):
+    def train_class(self, params, states, skip=0):
         """One training sweep of the TRAIN class with per-batch dropout
-        keys folded from the epoch's base key."""
-        idx = self._segment_indices(TRAIN)
+        keys folded from the epoch's base key.
+
+        On a mid-epoch resume (``skip`` > 0) the fold indices continue
+        from the batch position within the epoch, so the key sequence
+        matches an uninterrupted fused run of the same stream state."""
+        idx = self._segment_indices(TRAIN, skip=skip)
         base = self._dropout_base_key()
+        first = skip // self.loader.max_minibatch_size
         keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-            jnp.arange(idx.shape[0]))
+            jnp.arange(first, first + idx.shape[0]))
         return self._train_segment(params, states, jnp.asarray(idx), keys)
 
     # -- compilation hooks (overridden by parallel trainers) ---------------
@@ -331,11 +336,17 @@ class FusedTrainer(Logger):
 
     # -- index plumbing ----------------------------------------------------
 
-    def _segment_indices(self, klass):
-        """(n_batches, mb) int32 index matrix for a class, padded -1."""
+    def _segment_indices(self, klass, skip=0):
+        """(n_batches, mb) int32 index matrix for a class, padded -1.
+
+        ``skip`` drops the class's first samples — a mid-epoch snapshot
+        resume serves only the REMAINING minibatches through the same
+        scan (``veles/snapshotter.py:387-409`` resume semantics;
+        minibatch boundaries are class-aligned, so ``skip`` is a
+        multiple of the minibatch size)."""
         loader = self.loader
         ends = loader.class_end_offsets
-        start = ends[klass] - loader.class_lengths[klass]
+        start = ends[klass] - loader.class_lengths[klass] + skip
         seg = numpy.asarray(
             loader.shuffled_indices.map_read()[start:ends[klass]],
             numpy.int32)
