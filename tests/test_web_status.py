@@ -110,6 +110,16 @@ def test_html_pages(server):
     assert status == 200 and "command composer" in page
 
 
+def test_frontend_composer_renders_choices_and_help(server):
+    """The composer page renders real registry flags: enumerated
+    options become <select> dropdowns and each flag shows its help."""
+    status, page = _get(server.address, "/frontend.html")
+    assert status == 200
+    assert "createElement(\"select\")" in page
+    assert "arg.choices" in page
+    assert "arg.help" in page
+
+
 def test_catalog_endpoint(server):
     status, body = _get(server.address, "/catalog")
     assert status == 200

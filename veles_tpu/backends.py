@@ -172,7 +172,10 @@ class JaxDevice(Device):
         import jax
         self._jax_ = jax
         _enable_persistent_compile_cache()
-        devices = [d for d in jax.devices()
+        # LOCAL devices only: under multi-controller SPMD jax.devices()
+        # lists every process's devices, and committing unit arrays to
+        # another process's device makes them unreadable locally
+        devices = [d for d in jax.local_devices()
                    if self.PLATFORM in (None, d.platform)]
         if not devices:
             raise RuntimeError("no %s devices visible to JAX" % self.PLATFORM)
@@ -232,6 +235,32 @@ class CPUDevice(JaxDevice):
 
     BACKEND = "cpu"
     PLATFORM = "cpu"
+
+    def __init__(self, **kwargs):
+        # A child process (warm evaluator, spawned slave) inherits a
+        # sitecustomize that pins the TPU-relay platform; the
+        # JAX_PLATFORMS env var alone does not undo that, so an
+        # explicitly-CPU device must flip the config BEFORE
+        # jax.devices() runs — otherwise the child initializes (and
+        # BLOCKS on) the relay while e.g. a benchmark holds the chip.
+        import jax
+        try:
+            from jax._src import xla_bridge
+            initialized = xla_bridge.backends_are_initialized()
+        except Exception:
+            initialized = False
+        # Flip only when the PROCESS is declared CPU-only (the env var
+        # every spawned evaluator/slave/test sets): a mixed process
+        # that later wants Device(backend="tpu") must not have its
+        # global platform config pinned by a passing cpu device.
+        # Reading config.jax_platforms does NOT initialize backends
+        # (calling jax.default_backend() here would — and block on a
+        # busy relay).
+        if (not initialized and
+                os.environ.get("VELES_TPU_BACKEND") in ("cpu", "numpy")
+                and (jax.config.jax_platforms or "") != "cpu"):
+            jax.config.update("jax_platforms", "cpu")
+        super(CPUDevice, self).__init__(**kwargs)
 
     @classmethod
     def available(cls):

@@ -24,6 +24,102 @@ def validate_name(name):
     return name
 
 
+#: model-repository browser (the role of the reference's
+#: ``web/projects/forge`` app): lists models from the JSON API, click
+#: for version history + manifest, direct /fetch download links. All
+#: rendering goes through createElement/textContent — model names and
+#: descriptions are uploader-controlled and must never reach innerHTML.
+_BROWSE_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu forge</title><style>
+body { font-family: sans-serif; margin: 2em; background: #fafafa;
+       max-width: 75em; }
+table { border-collapse: collapse; width: 98em; max-width: 100em;
+        background: #fff; }
+td, th { border: 1px solid #ccc; padding: 0.4em 0.7em; text-align:
+left; }
+tr.model { cursor: pointer; } tr.model:hover { background: #eef3fa; }
+#details { background: #fff; border: 1px solid #ccc; padding: 1em;
+           margin-top: 1.5em; display: none; }
+pre { background: #f4f4f4; padding: 0.6em; overflow-x: auto; }
+a.dl { margin-right: 1em; }
+.muted { color: #666; font-size: 0.9em; }
+</style></head><body>
+<h1>forge model repository</h1>
+<p class="muted">versioned trained-model packages; click a row for
+version history and the manifest. Uploads go through
+<code>veles_tpu.forge.client</code> (token-authenticated).</p>
+<table id="models"><thead><tr><th>model</th><th>version</th>
+<th>author</th><th>description</th><th>updated</th></tr></thead>
+<tbody></tbody></table>
+<div id="details"></div>
+<script>
+const service = "__SERVICE__";
+function cell(tr, text) {
+  const td = document.createElement("td");
+  td.textContent = text == null ? "" : String(text);
+  tr.appendChild(td);
+  return td;
+}
+async function showDetails(name) {
+  const resp = await fetch(service + "?query=details&name=" +
+                           encodeURIComponent(name));
+  const d = await resp.json();
+  const box = document.getElementById("details");
+  box.textContent = "";
+  const h = document.createElement("h2");
+  h.textContent = d.name;
+  box.appendChild(h);
+  const vt = document.createElement("table");
+  const head = document.createElement("tr");
+  for (const t of ["version", "author", "uploaded", "download"])
+    { const th = document.createElement("th"); th.textContent = t;
+      head.appendChild(th); }
+  vt.appendChild(head);
+  for (const v of (d.versions || []).slice().reverse()) {
+    const tr = document.createElement("tr");
+    cell(tr, v.version); cell(tr, v.author); cell(tr, v.uploaded);
+    const td = document.createElement("td");
+    const a = document.createElement("a");
+    a.className = "dl";
+    a.href = "/fetch?name=" + encodeURIComponent(d.name) +
+             "&version=" + encodeURIComponent(v.version);
+    a.textContent = "package.tar";
+    td.appendChild(a); tr.appendChild(td);
+    vt.appendChild(tr);
+  }
+  box.appendChild(vt);
+  const mh = document.createElement("h3");
+  mh.textContent = "manifest (latest)";
+  box.appendChild(mh);
+  const pre = document.createElement("pre");
+  pre.textContent = JSON.stringify(d.manifest, null, 2);
+  box.appendChild(pre);
+  box.style.display = "block";
+}
+async function load() {
+  const resp = await fetch(service + "?query=list");
+  const models = await resp.json();
+  const tbody = document.querySelector("#models tbody");
+  tbody.textContent = "";
+  if (!models.length) {
+    const tr = document.createElement("tr");
+    cell(tr, "(no models uploaded yet)");
+    tbody.appendChild(tr);
+    return;
+  }
+  for (const m of models) {
+    const tr = document.createElement("tr");
+    tr.className = "model";
+    cell(tr, m.name); cell(tr, m.version); cell(tr, m.author);
+    cell(tr, m.description); cell(tr, m.updated);
+    tr.addEventListener("click", () => showDetails(m.name));
+    tbody.appendChild(tr);
+  }
+}
+load();
+</script></body></html>"""
+
+
 class ForgeServer(Logger):
     """Stores versioned packages under ``storage_dir``.
 
@@ -241,7 +337,12 @@ class _Handler(BaseHTTPRequestHandler):
         owner = self.server.owner
         service = "/" + root.common.forge.get("service_name", "forge")
         try:
-            if parsed.path == service:
+            if parsed.path in ("/", "/browse.html"):
+                self._reply(
+                    _BROWSE_PAGE.replace("__SERVICE__",
+                                         service).encode(),
+                    ctype="text/html; charset=utf-8")
+            elif parsed.path == service:
                 q = query.get("query")
                 if q == "list":
                     self._reply(owner.list_models())

@@ -7,10 +7,13 @@ sample pairs its topology with a committed deterministic generator of
 the same shape and difficulty class: ``wine`` (13-feature tabular,
 3 classes), ``lines`` (oriented-stroke images, 4 angle classes — the
 reference's conv primer), ``kanji`` (100-class warped glyph pairs on
-the golden-digit renderer). The ``channels`` sample (small-image
-multi-class conv classification) is the same problem family as
-lines/CIFAR and is covered by those configs. All run fused through
-StandardWorkflow.
+the golden-digit renderer), and ``channels`` (TV-channel LOGO
+recognition — the one sample whose distinctive capability is loading
+class-per-directory image TREES from disk: ``generate_channels_dataset``
+renders synthetic station logos into per-channel directories and
+:class:`ChannelsWorkflow` trains through the real
+``FileImageLoader``/scanner/decoder path, not an in-memory provider).
+All run fused through StandardWorkflow.
 """
 
 import numpy
@@ -167,6 +170,97 @@ class KanjiWorkflow(StandardWorkflow):
                 {"type": "max_pooling", "kx": 2, "ky": 2},
                 {"type": "all2all_relu", "output_sample_shape": 128},
                 {"type": "softmax", "output_sample_shape": 100},
+            ], **kwargs)
+
+
+def generate_channels_dataset(directory, n_channels=6, per_class=30,
+                              side=32, seed=21):
+    """Render a synthetic TV-channel-logo dataset into
+    ``<directory>/{train,validation}/<channel-name>/*.png``.
+
+    Each "channel" gets a distinct geometric emblem (bars / disc /
+    frame / checker / stripes / cross) with per-image position jitter
+    and background noise — the channels problem's shape (small images,
+    one logo class per directory) without its unfetchable data. Returns
+    the (train_paths, validation_paths) roots for
+    :class:`~veles_tpu.loader.image.FileImageLoader`."""
+    import os
+
+    from PIL import Image
+
+    rng = numpy.random.RandomState(seed)
+    names = ["channel%02d" % i for i in range(n_channels)]
+
+    def emblem(klass, jitter):
+        img = (rng.rand(side, side, 3) * 60).astype(numpy.uint8)
+        yy, xx = numpy.mgrid[0:side, 0:side]
+        cy, cx = side // 2 + jitter[0], side // 2 + jitter[1]
+        color = numpy.zeros(3, numpy.uint8)
+        color[klass % 3] = 230
+        color[(klass + 1) % 3] = 120 if klass >= 3 else 0
+        kind = klass % 6
+        if kind == 0:
+            mask = (xx // 4) % 2 == 0                       # bars
+        elif kind == 1:
+            mask = (yy - cy) ** 2 + (xx - cx) ** 2 < (side // 3) ** 2
+        elif kind == 2:
+            border = side // 5
+            mask = ((numpy.minimum.reduce([yy, xx, side - 1 - yy,
+                                           side - 1 - xx]) > border) &
+                    (numpy.minimum.reduce([yy, xx, side - 1 - yy,
+                                           side - 1 - xx]) < 2 * border))
+        elif kind == 3:
+            mask = ((yy // 4) + (xx // 4)) % 2 == 0         # checker
+        elif kind == 4:
+            mask = (yy // 4) % 2 == 0                       # stripes
+        else:
+            mask = (abs(yy - cy) < 3) | (abs(xx - cx) < 3)  # cross
+        img[mask] = color
+        return img
+
+    splits = {"train": per_class, "validation": max(per_class // 4, 2)}
+    for split, count in splits.items():
+        for klass, name in enumerate(names):
+            d = os.path.join(directory, split, name)
+            os.makedirs(d, exist_ok=True)
+            for i in range(count):
+                jitter = rng.randint(-3, 4, size=2)
+                Image.fromarray(emblem(klass, jitter)).save(
+                    os.path.join(d, "frame%03d.png" % i))
+    return ([os.path.join(directory, "train")],
+            [os.path.join(directory, "validation")])
+
+
+class ChannelsWorkflow(StandardWorkflow):
+    """Conv net over channel-logo image directories (reference
+    ``channels`` sample family): the loader is the real directory-tree
+    :class:`~veles_tpu.loader.image.FileImageLoader` — scan, decode,
+    resize, normalize — with labels from directory names."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, train_paths=(),
+                 validation_paths=(), n_classes=6, minibatch_size=30,
+                 size=(32, 32), **kwargs):
+        from veles_tpu.loader.image import FileImageLoader
+        kwargs.setdefault("learning_rate", 0.05)
+        kwargs.setdefault("loss", "softmax")
+        loader_kwargs = {
+            "train_paths": tuple(train_paths),
+            "validation_paths": tuple(validation_paths),
+            "size": size, "minibatch_size": minibatch_size,
+            "normalization_type": "linear",
+        }
+        super(ChannelsWorkflow, self).__init__(
+            workflow,
+            loader=lambda w: FileImageLoader(w, **loader_kwargs),
+            layers=[
+                {"type": "conv_relu", "n_kernels": 12, "kx": 5, "ky": 5},
+                {"type": "max_pooling", "kx": 2, "ky": 2},
+                {"type": "conv_relu", "n_kernels": 24, "kx": 3, "ky": 3},
+                {"type": "max_pooling", "kx": 2, "ky": 2},
+                {"type": "all2all_relu", "output_sample_shape": 64},
+                {"type": "softmax", "output_sample_shape": n_classes},
             ], **kwargs)
 
 

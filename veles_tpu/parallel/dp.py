@@ -16,7 +16,8 @@ Optionally combines with tensor parallelism: pass ``param_shardings``
 import jax
 import jax.numpy as jnp
 
-from veles_tpu.parallel.mesh import build_mesh, named_sharding
+from veles_tpu.parallel.mesh import (build_mesh, named_sharding,
+                                     put_global)
 from veles_tpu.train.step import FusedTrainer
 
 
@@ -58,7 +59,7 @@ class DataParallelTrainer(FusedTrainer):
             if pad:
                 a = numpy.concatenate(
                     [a, numpy.zeros((pad,) + a.shape[1:], a.dtype)])
-            return jax.device_put(a, self._data_spec)
+            return put_global(a, self._data_spec)
 
         self._data_args = tuple(shard_rows(a) for a in self._data_args)
 
@@ -75,22 +76,39 @@ class DataParallelTrainer(FusedTrainer):
         data_spec = (self._data_spec, self._data_spec)
         # idx_matrix: (n_batches, mb) — shard the per-step batch dim
         idx_spec = named_sharding(self.mesh, None, self.axis)
-        return jax.jit(
+        jitted = jax.jit(
             fn,
             in_shardings=(data_spec, params_spec, repl, idx_spec, repl),
             out_shardings=(params_spec, repl, repl, repl),
             donate_argnums=(1, 2) if self.donate else ())
+        if jax.process_count() == 1:
+            return jitted
+
+        def multihost_call(data_args, params, states, idx, keys):
+            # host-built idx/keys must be placed explicitly under
+            # multi-controller SPMD (implicit device_put would reject
+            # the cross-process sharding)
+            return jitted(data_args, params, states,
+                          put_global(idx, idx_spec),
+                          put_global(keys, repl))
+        return multihost_call
 
     def _compile_eval(self, fn):
         repl = named_sharding(self.mesh)
         idx_spec = named_sharding(self.mesh, None, self.axis)
         # out_shardings as a single spec: the eval returns 2 leaves
         # (losses, metrics) or 3 when confusion rides the scan
-        return jax.jit(
+        jitted = jax.jit(
             fn,
             in_shardings=((self._data_spec, self._data_spec),
                           self._params_spec(), idx_spec),
             out_shardings=repl)
+        if jax.process_count() == 1:
+            return jitted
+
+        def multihost_call(data_args, params, idx):
+            return jitted(data_args, params, put_global(idx, idx_spec))
+        return multihost_call
 
     def pull_params(self):
         """Re-place host-committed params onto the mesh per the declared
@@ -101,12 +119,12 @@ class DataParallelTrainer(FusedTrainer):
         if not isinstance(spec, (tuple, list)):
             spec = tuple(spec for _ in params)
         params = tuple(
-            {k: jax.device_put(v, spec[i][k]
-                               if isinstance(spec[i], dict)
-                               else spec[i])
+            {k: put_global(v, spec[i][k]
+                           if isinstance(spec[i], dict)
+                           else spec[i])
              for k, v in layer.items()}
             for i, layer in enumerate(params))
         repl = named_sharding(self.mesh)
         states = jax.tree_util.tree_map(
-            lambda v: jax.device_put(v, repl), states)
+            lambda v: put_global(v, repl), states)
         return params, states

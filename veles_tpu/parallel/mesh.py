@@ -61,6 +61,21 @@ def build_mesh(axes=None, devices=None):
     return jax.sharding.Mesh(dev_array, tuple(names))
 
 
+def put_global(host_array, sharding):
+    """``device_put`` that also works under multi-controller SPMD.
+
+    In a multi-host runtime a plain ``device_put`` onto a sharding
+    whose devices span processes is rejected (non-addressable);
+    ``make_array_from_callback`` lets every process contribute just its
+    addressable shards, sliced from the same full host array (every
+    controller holds identical data — same seeds, same loader)."""
+    if jax.process_count() == 1:
+        return jax.device_put(host_array, sharding)
+    host_array = numpy.asarray(host_array)
+    return jax.make_array_from_callback(
+        host_array.shape, sharding, lambda idx: host_array[idx])
+
+
 def named_sharding(mesh, *spec):
     """Shorthand for NamedSharding(mesh, PartitionSpec(*spec))."""
     return jax.sharding.NamedSharding(

@@ -164,15 +164,36 @@ async function load() {
     const label = document.createElement("label");
     label.textContent = arg.flags.join(", ");
     label.title = arg.help;
-    const input = document.createElement("input");
-    input.id = "arg-" + arg.dest;
+    let input;
     if (arg.kind === "flag") {
+      input = document.createElement("input");
       input.type = "checkbox";
+    } else if (arg.choices && arg.choices.length) {
+      input = document.createElement("select");
+      const blank = document.createElement("option");
+      blank.value = "";
+      blank.textContent = "(default)";
+      input.appendChild(blank);
+      for (const c of arg.choices) {
+        const opt = document.createElement("option");
+        opt.value = c;
+        opt.textContent = c;
+        input.appendChild(opt);
+      }
     } else {
+      input = document.createElement("input");
       input.placeholder = arg.default === null ? "" : String(arg.default);
     }
+    input.id = "arg-" + arg.dest;
     input.addEventListener("input", rebuild);
+    input.addEventListener("change", rebuild);
     div.appendChild(label); div.appendChild(input);
+    if (arg.help) {
+      const doc = document.createElement("span");
+      doc.className = "doc";
+      doc.textContent = " — " + arg.help;
+      div.appendChild(doc);
+    }
     argsDiv.appendChild(div);
   }
   const tbody = document.querySelector("#units tbody");
