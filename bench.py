@@ -93,20 +93,31 @@ def main():
     from veles_tpu.train import FusedTrainer
 
     set_policy(PRECISION)
-    batch = 128
-    n_train = 1024
+    batch = int(os.environ.get("VELES_BENCH_BATCH", 128))
+    # 16k samples (bf16-stored, ~5 GB HBM) instead of r2's 1k: the
+    # live-loss phase descends visibly from the fresh-model ~6.9
+    # (VERDICT r2 weak #2), and the 128-step compiled segments this
+    # size produces lifted throughput ~8% by amortizing per-dispatch
+    # overhead (docs/PERF.md r3).
+    n_train = int(os.environ.get("VELES_BENCH_NTRAIN", 16384))
     prng.get().seed(42)
     prng.get("loader").seed(43)
     wf = AlexNetWorkflow(
         DummyLauncher(),
         loader_factory=lambda w: SyntheticImageLoader(
             w, n_train=n_train, n_valid=batch, side=227, n_classes=1000,
-            minibatch_size=batch),
+            minibatch_size=batch, dtype="bfloat16"),
         layers=ALEXNET_LAYERS, max_epochs=1)
     wf.initialize(device=Device(backend=None))
 
+    import numpy
+
     trainer = FusedTrainer(wf)
     params, states = trainer.pull_params()
+    # host-side snapshot of the fresh model: the warmup DONATES these
+    # device buffers, so the timed window re-uploads from here to start
+    # from an untrained model (live descending loss)
+    host_init = jax.tree_util.tree_map(numpy.asarray, (params, states))
     idx = trainer._segment_indices(2)  # TRAIN segment index matrix
     keys = jax.random.split(jax.random.PRNGKey(0), idx.shape[0])
     idx = jnp.asarray(idx)
@@ -123,11 +134,32 @@ def main():
     print("warmup (compile + settle): %.1fs" % (time.time() - t_compile),
           file=sys.stderr)
 
-    # steady state: full training epochs until the window is >=30 s.
-    # One forcing read per chunk (float() pulls the scalar through the
-    # remote-execution relay; block_until_ready alone can return early)
-    # — 20 epochs per chunk keeps the relay round-trips amortized.
-    chunk = 20
+    # -- phase 1 (untimed): LIVE-LOSS evidence. Restart from the fresh
+    # model and read the loss after every epoch — the descent from
+    # ~ln(1000) is the signal a silent gradient regression would erase
+    # (VERDICT r2 weak #2). Reads are eager and this phase is NOT
+    # timed: a mid-window read (or even retaining the loss arrays)
+    # serializes the relay's execution pipeline and halves throughput.
+    params, states = jax.tree_util.tree_map(jnp.asarray, host_init)
+    series = []
+    for _ in range(10):
+        params, states, losses, _ = trainer._train_segment(
+            params, states, idx, keys)
+        series.append(float(losses[-1]))
+    print("loss per epoch (fresh model): %s  (policy=%s, %d samples)"
+          % (" ".join("%.3f" % v for v in series), PRECISION, n_train),
+          file=sys.stderr)
+    if not (series[0] > series[-1] >= 0.0 and series[0] > 1.0):
+        print("WARNING: loss not live/decreasing — gradient regression?",
+              file=sys.stderr)
+
+    # -- phase 2 (timed): steady-state throughput, continuing the same
+    # training run. One forcing read per chunk (float() pulls a scalar
+    # through the relay; block_until_ready alone can return early);
+    # ~20 segments in flight both amortize the round-trips and stay
+    # under the relay's async-queue limit (deeper queues are rejected
+    # with INVALID_ARGUMENT).
+    chunk = min(20, max(1, 2560 // idx.shape[0]))
     epochs = 0
     start = time.time()
     while True:
@@ -139,8 +171,9 @@ def main():
         elapsed = time.time() - start
         if elapsed >= MIN_TIMED_WINDOW_S:
             break
-    print("final loss: %.4f  (policy=%s, %d epochs, %.1fs window)"
-          % (final_loss, PRECISION, epochs, elapsed), file=sys.stderr)
+    print("timed window: %d epochs x %d samples in %.1fs, loss %.3f -> "
+          "%.4f" % (epochs, n_train, elapsed, series[-1], final_loss),
+          file=sys.stderr)
 
     samples_per_sec = epochs * n_train / elapsed
 
