@@ -195,6 +195,15 @@ class Main(Logger):
             return
         self.launcher.run()
         self._write_results()
+        # exit reports, as the reference printed at shutdown: slowest
+        # units (``veles/workflow.py:788-825``) and peak device memory
+        # (``veles/__main__.py:779-797`` + memory.py Watcher)
+        self.workflow.print_stats()
+        from veles_tpu.memory import watcher
+        mem = watcher.report()
+        self.info("device memory: %.1f MB in use, %.1f MB peak, "
+                  "%d arrays", mem["bytes_in_use"] / 1e6,
+                  mem["peak_bytes"] / 1e6, mem["arrays"])
 
     def _write_results(self):
         if not self.args.result_file:

@@ -150,6 +150,17 @@ class Array(object):
                 return
             self._devmem_ = new_devmem
             self._state_ = DEV_DIRTY
+            # account buffers that arrive device-side too (forward
+            # outputs, err_inputs): without this the Watcher's
+            # in-use/peak report only saw host-uploaded weights
+            old = self._accounted_
+            new = getattr(new_devmem, "nbytes", 0)
+            if old != new:
+                if old:
+                    watcher.remove(old)
+                if new:
+                    watcher.add(new)
+                self._accounted_ = new
 
     def _upload(self):
         old = self._accounted_

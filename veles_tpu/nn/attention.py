@@ -1,0 +1,139 @@
+"""Trainable multi-head self-attention.
+
+The 2015 reference has no attention anywhere (SURVEY.md §5 records it
+absent) — this unit is the beyond-reference long-context building
+block the TPU build treats as first-class: single-device it runs the
+flash-style streaming softmax (:func:`local_attention`), and with a
+``seq`` mesh attached the SAME unit computes exact attention over a
+sequence sharded across devices via ring attention
+(:mod:`veles_tpu.parallel.sequence`) — K/V blocks rotate on ICI while
+each chip accumulates its query block. Both paths are pure ``apply``
+functions, so the generic vjp GD unit trains them with no bespoke
+backward (the ring's scan + ppermute transpose IS the backward ring).
+
+Parameters pack as one ``weights`` tensor (4, dim, dim) — rows are the
+Q/K/V/output projections — so every existing mechanism (filler,
+snapshots, param-server deltas, solvers) applies unchanged.
+"""
+
+import jax.numpy as jnp
+
+from veles_tpu.nn.base import ForwardBase
+from veles_tpu.nn.gd import GradientDescentBase
+from veles_tpu.parallel.sequence import local_attention, ring_attention
+
+
+class MultiHeadAttentionForward(ForwardBase):
+    """Self-attention over (batch, seq, dim) inputs, residual output."""
+
+    hide_from_registry = False
+
+    def __init__(self, workflow, heads=4, causal=True, residual=True,
+                 **kwargs):
+        super(MultiHeadAttentionForward, self).__init__(workflow,
+                                                        **kwargs)
+        self.heads = int(heads)
+        self.causal = causal
+        #: add x to the attention output (the transformer block wiring;
+        #: also keeps deep stacks trainable at plain-SGD rates)
+        self.residual = residual
+        self._seq_mesh_ = None
+        self._seq_axis_ = "seq"
+
+    def use_ring(self, mesh, axis="seq"):
+        """Attach a sequence mesh: apply() switches to ring attention.
+
+        Runtime configuration (meshes are process-local device handles,
+        so this is transient state — reattach after a snapshot resume).
+        """
+        self._seq_mesh_ = mesh
+        self._seq_axis_ = axis
+        return self
+
+    def init_unpickled(self):
+        super(MultiHeadAttentionForward, self).init_unpickled()
+        self._seq_mesh_ = None
+        self._seq_axis_ = "seq"
+
+    def param_values(self):
+        """With a seq mesh attached, committed single-device parameter
+        buffers must be re-placed onto the mesh (replicated) or the
+        ring's shard_map rejects the device-set mismatch."""
+        params = super(MultiHeadAttentionForward, self).param_values()
+        if self._seq_mesh_ is not None:
+            import jax
+
+            from veles_tpu.parallel.mesh import named_sharding
+            repl = named_sharding(self._seq_mesh_)
+            params = {k: jax.device_put(v, repl)
+                      for k, v in params.items()}
+        return params
+
+    def _input_devmem(self):
+        return self.place_for_grad(
+            super(MultiHeadAttentionForward, self)._input_devmem())
+
+    def place_for_grad(self, tree):
+        """Re-place committed single-device arrays (inputs, err_output,
+        optimizer state) onto the seq mesh, replicated — uncommitted
+        host arrays pass through untouched."""
+        if self._seq_mesh_ is None:
+            return tree
+        import jax
+
+        from veles_tpu.parallel.mesh import named_sharding
+        repl = named_sharding(self._seq_mesh_)
+
+        def place(v):
+            return jax.device_put(v, repl) if hasattr(v, "sharding") \
+                else v
+
+        return jax.tree_util.tree_map(place, tree)
+
+    def weights_shape_for(self, input_shape):
+        dim = input_shape[-1]
+        if dim % self.heads:
+            raise ValueError("dim %d not divisible by %d heads"
+                             % (dim, self.heads))
+        return (4, dim, dim)
+
+    def bias_shape_for(self, input_shape):
+        return (4, input_shape[-1])
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def apply(self, params, x):
+        w = params["weights"]
+        b = params.get("bias")
+        batch, seq, dim = x.shape
+        heads, head_dim = self.heads, dim // self.heads
+
+        def proj(i, t):
+            y = jnp.einsum("bsd,de->bse", t, w[i],
+                           preferred_element_type=jnp.float32)
+            if b is not None:
+                y = y + b[i]
+            return y
+
+        def split(t):  # (B, S, D) -> (B, H, S, hd)
+            return t.reshape(batch, seq, heads, head_dim).transpose(
+                0, 2, 1, 3)
+
+        q, k, v = (split(proj(i, x)) for i in range(3))
+        if self._seq_mesh_ is not None:
+            ctx = ring_attention(q, k, v, self._seq_mesh_,
+                                 self._seq_axis_, causal=self.causal)
+        else:
+            ctx = local_attention(q, k, v, causal=self.causal)
+        merged = ctx.transpose(0, 2, 1, 3).reshape(batch, seq, dim)
+        out = proj(3, merged)
+        if self.residual:
+            out = out + x
+        return out.astype(x.dtype)
+
+
+class GDAttention(GradientDescentBase):
+    """Backward for the attention block: the generic vjp covers it —
+    including THROUGH the ring (scan of ppermutes transposes to the
+    reverse ring)."""

@@ -69,6 +69,13 @@ class ForwardBase(AcceleratedUnit):
         evaluator seeds the gradient w.r.t. logits)."""
         return self.apply(params, x)
 
+    def place_for_grad(self, tree):
+        """Hook for units whose ``apply`` runs on a device mesh: the
+        paired GD step routes its other inputs (err_output, optimizer
+        state) through here so committed single-device buffers can be
+        re-placed to match. Identity by default."""
+        return tree
+
     # -- parameter handling ------------------------------------------------
 
     def fill_weights(self):

@@ -97,14 +97,18 @@ class GradientDescentBase(AcceleratedUnit):
         fwd = self.forward
         self.unmap_vectors(self.err_output, fwd.weights, fwd.bias)
         params = fwd.param_values()
-        x = (fwd.input.devmem if isinstance(fwd.input, Array)
-             else fwd.input)
+        # _input_devmem / place_for_grad: mesh-running forwards
+        # (ring-attention units) re-place committed single-device
+        # buffers so the jitted step sees one consistent device set
+        x = fwd._input_devmem()
         err_out = (self.err_output.devmem
                    if isinstance(self.err_output, Array)
                    else self.err_output)
+        err_out = fwd.place_for_grad(err_out)
+        state = fwd.place_for_grad(self.opt_state or {})
         step = self.jit(self._get_step())
         new_params, gx, new_state = step(params, x, err_out,
-                                         self.opt_state or {}, self.hyper)
+                                         state, self.hyper)
         for k, arr in fwd.param_arrays().items():
             arr.assign_devmem(new_params[k])
         self.opt_state = new_state

@@ -71,7 +71,7 @@ def ring_attention(q, k, v, mesh, axis="seq", causal=False, scale=None):
         acc = jnp.zeros(q_blk.shape[:3] + (q_blk.shape[3],), jnp.float32)
         perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
-        def hop(h, carry):
+        def hop(carry, h):
             k_cur, v_cur, m, l, acc = carry
             src_idx = (my_idx - h) % n_shards
             k_off = src_idx * seq_shard
@@ -80,11 +80,13 @@ def ring_attention(q, k, v, mesh, axis="seq", causal=False, scale=None):
             # rotate K/V to the next device while nothing depends on it
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return k_nxt, v_nxt, m, l, acc
+            return (k_nxt, v_nxt, m, l, acc), None
 
-        k_cur, v_cur = k_blk, v_blk
-        carry = (k_cur, v_cur, m, l, acc)
-        carry = jax.lax.fori_loop(0, n_shards, hop, carry)
+        # lax.scan (not fori_loop): reverse-mode AD flows through the
+        # ring — ppermute's transpose is the inverse rotation, so
+        # training THROUGH ring attention needs nothing special
+        carry = (k_blk, v_blk, m, l, acc)
+        carry, _ = jax.lax.scan(hop, carry, jnp.arange(n_shards))
         _, _, m, l, acc = carry
         l = jnp.maximum(l, 1e-30)
         return (acc / l[..., None]).astype(q_blk.dtype)

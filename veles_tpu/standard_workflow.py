@@ -18,6 +18,7 @@ from veles_tpu.nn.activation import ActivationUnit
 from veles_tpu.nn.all2all import (All2All, All2AllRELU, All2AllSigmoid,
                                   All2AllSoftmax, All2AllStrictRELU,
                                   All2AllTanh)
+from veles_tpu.nn.attention import MultiHeadAttentionForward
 from veles_tpu.nn.conv import (Conv, ConvRELU, ConvSigmoid,
                                ConvStrictRELU, ConvTanh, Deconv)
 from veles_tpu.nn.decision import DecisionGD, DecisionMSE
@@ -50,6 +51,7 @@ LAYER_TYPES = {
     "norm": LRNormalizerForward,
     "dropout": DropoutForward,
     "activation": ActivationUnit,
+    "attention": MultiHeadAttentionForward,
 }
 
 
