@@ -132,3 +132,24 @@ def test_mha_trains_through_generic_gd(ring):
 def test_attention_in_standard_workflow_registry():
     from veles_tpu.standard_workflow import LAYER_TYPES
     assert LAYER_TYPES["attention"] is MultiHeadAttentionForward
+
+
+def test_sequence_workflow_trains_fused():
+    """The attention stack as a full StandardWorkflow: needle-token
+    classification must train FUSED (the step compiler differentiates
+    through the attention layers like any other) to low error."""
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.models.samples import SequenceWorkflow
+
+    prng._generators.clear()
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    launcher = Launcher(graphics=False)
+    wf = SequenceWorkflow(launcher, max_epochs=12)
+    launcher.initialize()
+    launcher.run()
+    assert launcher.run_mode_used == "fused"
+    assert wf.loader.original_data.shape[1:] == (16, 16)  # kept 3-D
+    best = min(h["validation"]["normalized"]
+               for h in wf.decision.epoch_history)
+    assert best <= 0.12, best

@@ -130,12 +130,16 @@ class ProviderLoader(FullBatchLoader):
 
     hide_from_registry = True
 
-    def __init__(self, workflow, provider=None, flatten=False, **kwargs):
+    def __init__(self, workflow, provider=None, flatten=False,
+                 sequence=False, **kwargs):
         super(ProviderLoader, self).__init__(workflow, **kwargs)
         self.provider = provider
         #: flat (n, features) for FC topologies; otherwise 3-D arrays
         #: grow a singleton channel for NHWC conv stacks
         self.flatten = flatten
+        #: 3-D samples are (seq, dim) token sequences for attention
+        #: stacks — keep them 3-D instead of growing an NHWC channel
+        self.sequence = sequence
 
     def load_dataset(self):
         train_x, train_y, valid_x, valid_y = self.provider()
@@ -145,7 +149,7 @@ class ProviderLoader(FullBatchLoader):
             numpy.int32)
         if self.flatten:
             data = data.reshape(len(data), -1)
-        elif data.ndim == 3:
+        elif data.ndim == 3 and not self.sequence:
             data = data[..., None]  # NHWC single channel
         self.original_data.reset(data)
         self.original_labels.reset(labels)

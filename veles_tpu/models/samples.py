@@ -264,6 +264,62 @@ class ChannelsWorkflow(StandardWorkflow):
             ], **kwargs)
 
 
+class SequenceProvider(object):
+    """Needle-token sequence classification (the attention sample's
+    task): every sample is a (seq, dim) block of noise tokens with ONE
+    position carrying one of ``n_classes`` fixed key patterns; the
+    label is which pattern. Content-based lookup across positions —
+    attention's home turf (the 2015 reference has no sequence models
+    at all)."""
+
+    def __init__(self, n_train=1600, n_valid=320, seq=16, dim=16,
+                 n_classes=8, seed=23):
+        self.args = (n_train, n_valid, seq, dim, n_classes, seed)
+
+    def __call__(self):
+        n_train, n_valid, seq, dim, n_classes, seed = self.args
+        rng = numpy.random.RandomState(seed)
+        patterns = rng.randn(n_classes, dim).astype(numpy.float32) * 2.0
+
+        def make(n):
+            x = rng.randn(n, seq, dim).astype(numpy.float32) * 0.3
+            y = rng.randint(0, n_classes, n).astype(numpy.int32)
+            pos = rng.randint(0, seq, n)
+            x[numpy.arange(n), pos] = patterns[y] + \
+                rng.randn(n, dim).astype(numpy.float32) * 0.2
+            return x, y
+
+        tx, ty = make(n_train)
+        vx, vy = make(n_valid)
+        return tx, ty, vx, vy
+
+
+class SequenceWorkflow(StandardWorkflow):
+    """Attention stack over token sequences: the beyond-reference
+    long-context building block as a full training workflow — runs
+    FUSED through the same step compiler as every other sample, and
+    each attention layer can switch to ring attention on a seq mesh
+    (``MultiHeadAttentionForward.use_ring``)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, provider=None, minibatch_size=80,
+                 heads=4, n_classes=8, **kwargs):
+        provider = provider or SequenceProvider(n_classes=n_classes)
+        kwargs.setdefault("learning_rate", 0.1)
+        kwargs.setdefault("loss", "softmax")
+        super(SequenceWorkflow, self).__init__(
+            workflow,
+            loader=lambda w: TabularLoader(
+                w, provider=provider, minibatch_size=minibatch_size,
+                sequence=True, normalization_type="none"),
+            layers=[
+                {"type": "attention", "heads": heads, "causal": False},
+                {"type": "attention", "heads": heads, "causal": False},
+                {"type": "softmax", "output_sample_shape": n_classes},
+            ], **kwargs)
+
+
 class LinesWorkflow(StandardWorkflow):
     """Small conv net over oriented strokes (reference lines sample)."""
 
