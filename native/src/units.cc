@@ -403,6 +403,108 @@ class ActivationUnitImpl : public Unit {
   ActFn act_ = ActLinear;
 };
 
+// ----------------------------------------------------- MultiHeadAttention
+
+class AttentionUnit : public Unit {
+ public:
+  const char* Name() const override { return "MultiHeadAttention"; }
+
+  Shape Initialize(const Shape& input_shape) override {
+    input_shape_ = input_shape;
+    if (input_shape.size() != 2) {
+      throw std::runtime_error("attention needs (seq, dim) samples");
+    }
+    seq_ = input_shape[0];
+    dim_ = input_shape[1];
+    const NpyArray* w = Array("weights");
+    if (w == nullptr || w->shape.size() != 3 || w->shape[0] != 4 ||
+        w->shape[1] != dim_ || w->shape[2] != dim_) {
+      throw std::runtime_error("attention needs (4, dim, dim) weights");
+    }
+    heads_ = static_cast<int64_t>(Param("heads", 4));
+    if (heads_ <= 0 || dim_ % heads_ != 0) {
+      throw std::runtime_error("dim not divisible by heads");
+    }
+    head_dim_ = dim_ / heads_;
+    causal_ = Param("causal", 0) != 0;
+    residual_ = Param("residual", 1) != 0;
+    output_shape_ = input_shape;
+    return output_shape_;
+  }
+
+  void Execute(const float* input, float* output,
+               int64_t batch) const override {
+    const float* w = Array("weights")->data.data();
+    const NpyArray* bias = Array("bias");
+    const float* b = bias != nullptr ? bias->data.data() : nullptr;
+    const float scale = 1.0f / std::sqrt(
+        static_cast<float>(head_dim_));
+    const int64_t plane = seq_ * dim_;
+    std::vector<float> q(plane), k(plane), v(plane), merged(plane);
+    std::vector<float> scores(seq_);
+    for (int64_t bi = 0; bi < batch; ++bi) {
+      const float* x = input + bi * plane;
+      Project(x, w + 0 * dim_ * dim_, b ? b + 0 * dim_ : nullptr,
+              q.data());
+      Project(x, w + 1 * dim_ * dim_, b ? b + 1 * dim_ : nullptr,
+              k.data());
+      Project(x, w + 2 * dim_ * dim_, b ? b + 2 * dim_ : nullptr,
+              v.data());
+      for (int64_t h = 0; h < heads_; ++h) {
+        const int64_t off = h * head_dim_;
+        for (int64_t i = 0; i < seq_; ++i) {
+          const float* qi = q.data() + i * dim_ + off;
+          const int64_t limit = causal_ ? i + 1 : seq_;
+          for (int64_t j = 0; j < limit; ++j) {
+            const float* kj = k.data() + j * dim_ + off;
+            float dot = 0.0f;
+            for (int64_t d = 0; d < head_dim_; ++d) dot += qi[d] * kj[d];
+            scores[j] = dot * scale;
+          }
+          Softmax(scores.data(), limit);
+          float* out_i = merged.data() + i * dim_ + off;
+          std::fill(out_i, out_i + head_dim_, 0.0f);
+          for (int64_t j = 0; j < limit; ++j) {
+            const float* vj = v.data() + j * dim_ + off;
+            const float p = scores[j];
+            for (int64_t d = 0; d < head_dim_; ++d) out_i[d] += p * vj[d];
+          }
+        }
+      }
+      float* out = output + bi * plane;
+      Project(merged.data(), w + 3 * dim_ * dim_,
+              b ? b + 3 * dim_ : nullptr, out);
+      if (residual_) {
+        for (int64_t i = 0; i < plane; ++i) out[i] += x[i];
+      }
+    }
+  }
+
+ private:
+  // (seq, dim) x (dim, dim) + bias -> (seq, dim)
+  void Project(const float* x, const float* w, const float* bias,
+               float* out) const {
+    for (int64_t s = 0; s < seq_; ++s) {
+      float* row = out + s * dim_;
+      if (bias != nullptr) {
+        std::memcpy(row, bias, dim_ * sizeof(float));
+      } else {
+        std::fill(row, row + dim_, 0.0f);
+      }
+      const float* xin = x + s * dim_;
+      for (int64_t kk = 0; kk < dim_; ++kk) {
+        const float xv = xin[kk];
+        if (xv == 0.0f) continue;
+        const float* w_row = w + kk * dim_;
+        for (int64_t j = 0; j < dim_; ++j) row[j] += xv * w_row[j];
+      }
+    }
+  }
+
+  int64_t seq_ = 0, dim_ = 0, heads_ = 0, head_dim_ = 0;
+  bool causal_ = false, residual_ = true;
+};
+
 class IdentityUnit : public Unit {
  public:
   const char* Name() const override { return "Identity"; }
@@ -444,6 +546,7 @@ void RegisterBuiltinUnits() {
   f.Register("LRNormalizerForward", Make<LrnUnit>);
   f.Register("ActivationUnit", Make<ActivationUnitImpl>);
   f.Register("DropoutForward", Make<IdentityUnit>);
+  f.Register("MultiHeadAttentionForward", Make<AttentionUnit>);
   // stable uuid5(namespace, class name) ids matching the Python-side
   // UnitRegistry (veles_tpu/unit_registry.py); regenerate with:
   //   python -c "import uuid; ns=uuid.UUID('6ba7b812-9dad-11d1-80b4-
@@ -473,6 +576,8 @@ void RegisterBuiltinUnits() {
                  "ActivationUnit");
   f.RegisterUuid("be4621cf-8dde-51b6-ad4d-9e7a1ded811b",
                  "DropoutForward");
+  f.RegisterUuid("794d6e18-a610-5449-8002-e65c30c7b62e",
+                 "MultiHeadAttentionForward");
 }
 
 }  // namespace veles_native

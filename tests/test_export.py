@@ -139,6 +139,29 @@ def test_native_matches_jax_conv_stack(native_lib, tmp_path):
     numpy.testing.assert_allclose(got, expect, rtol=5e-5, atol=5e-6)
 
 
+def test_native_matches_jax_attention(native_lib, tmp_path):
+    """The beyond-reference attention layer exports too: the C++
+    runtime's MultiHeadAttention matches the JAX forward (projections,
+    per-head softmax, residual) on an exported sequence model."""
+    from veles_tpu.export.native import NativeWorkflow
+    from veles_tpu.models.samples import SequenceWorkflow
+
+    prng._generators.clear()
+    prng.get().seed(41)
+    prng.get("loader").seed(42)
+    wf = SequenceWorkflow(max_epochs=1, minibatch_size=40)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    path = wf.package_export(str(tmp_path / "seq_model.tar"))
+    rng = numpy.random.RandomState(9)
+    batch = rng.rand(6, 16, 16).astype(numpy.float32)
+    expect = _jax_forward(wf, batch).reshape(6, -1)
+    with NativeWorkflow(path) as native:
+        assert native.unit_count == 3
+        got = native.run(batch)
+    numpy.testing.assert_allclose(got, expect, rtol=5e-5, atol=5e-6)
+
+
 def test_cli_runner_end_to_end(native_lib, tmp_path):
     from veles_tpu.export.native import runner_path
     wf = _mnist_workflow()

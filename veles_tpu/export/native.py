@@ -22,11 +22,27 @@ _build_lock = threading.Lock()
 _lib = None
 
 
+def _sources_mtime():
+    newest = 0.0
+    for base, _, files in os.walk(os.path.join(NATIVE_DIR, "src")):
+        for name in files:
+            newest = max(newest, os.path.getmtime(
+                os.path.join(base, name)))
+    cmake = os.path.join(NATIVE_DIR, "CMakeLists.txt")
+    if os.path.exists(cmake):
+        newest = max(newest, os.path.getmtime(cmake))
+    return newest
+
+
 def build_native(force=False):
-    """Build (or reuse) the native runtime; returns the .so path."""
+    """Build (or reuse) the native runtime; returns the .so path.
+
+    Reuses the library only while it is NEWER than every source file —
+    a stale .so silently missing new units cost a debugging round."""
     lib_path = os.path.join(BUILD_DIR, "libveles_native.so")
     with _build_lock:
-        if os.path.exists(lib_path) and not force:
+        if os.path.exists(lib_path) and not force and \
+                os.path.getmtime(lib_path) >= _sources_mtime():
             return lib_path
         os.makedirs(BUILD_DIR, exist_ok=True)
         subprocess.run(

@@ -113,6 +113,16 @@ def _export_dropout(unit):
     return {"identity": True}
 
 
+@exporter("MultiHeadAttentionForward")
+def _export_attention(unit):
+    data = _common(unit)   # weights (4, D, D) + bias (4, D)
+    data["heads"] = int(unit.heads)
+    # booleans ride as 0/1: the native JSON reader's numeric accessor
+    data["causal"] = int(bool(unit.causal))
+    data["residual"] = int(bool(unit.residual))
+    return data
+
+
 class _MemberWriter(object):
     """Allocates @NNNN_shape member names and collects npy blobs."""
 
