@@ -26,3 +26,44 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", jax.default_backend()
+
+
+# -- per-test watchdog (the reference's tests/timeout.py:36-60 role) --------
+#
+# A wedged test (deadlocked coordinator thread, stuck subprocess) must
+# fail loudly with stacks, not hang CI. The watchdog interrupts the
+# main thread after VELES_TEST_TIMEOUT seconds (default 600).
+
+import faulthandler  # noqa: E402
+import threading  # noqa: E402
+import _thread  # noqa: E402
+
+import pytest  # noqa: E402
+
+_TEST_TIMEOUT = float(os.environ.get("VELES_TEST_TIMEOUT", 600))
+
+
+@pytest.fixture(autouse=True)
+def _test_watchdog():
+    if _TEST_TIMEOUT <= 0:
+        yield
+        return
+    fired = threading.Event()
+
+    def trip():
+        fired.set()
+        sys.stderr.write(
+            "\n[watchdog] test exceeded %.0fs — thread stacks follow\n"
+            % _TEST_TIMEOUT)
+        faulthandler.dump_traceback()
+        _thread.interrupt_main()
+
+    timer = threading.Timer(_TEST_TIMEOUT, trip)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+        if fired.is_set():
+            pytest.fail("test exceeded the %.0fs watchdog" % _TEST_TIMEOUT)
+    finally:
+        timer.cancel()

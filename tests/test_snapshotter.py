@@ -94,6 +94,50 @@ def test_resume_matches_uninterrupted_run(tmp_path):
     assert restored.loader.epoch_number == straight.loader.epoch_number
 
 
+def test_mid_epoch_resume_preserves_partial_epoch_sums():
+    """The eager scheduler accumulates decision.epoch_stats per
+    minibatch; a mid-epoch snapshot resume must NOT reset them (the
+    resumed epoch would otherwise close short). Pins the
+    decision.initialize snapshot-resume branch for the eager path
+    (the fused path has its own test in test_fused_runner)."""
+    from veles_tpu.nn.decision import DecisionGD
+
+    wf = build(max_epochs=2)
+    calls = [0]
+    orig_run = DecisionGD.run
+
+    def counting_run(self):
+        orig_run(self)
+        calls[0] += 1
+        if calls[0] == 8:  # epoch 0 = 6 minibatches; stop mid-epoch 1
+            self.workflow.stop()
+
+    DecisionGD.run = counting_run
+    try:
+        wf.run()
+    finally:
+        DecisionGD.run = orig_run
+    assert 0 < wf.loader._global_offset < wf.loader.total_samples
+    partial = [dict(s) for s in wf.decision.epoch_stats]
+    assert any(s["samples"] for s in partial)
+
+    blob = dump_workflow(wf)
+    prng._generators.clear()
+    restored = load_workflow(blob)
+    restored.workflow = DummyLauncher()
+    restored.initialize(device=Device(backend="numpy"))
+    # the partial sums survived initialize()
+    for before, after in zip(partial, restored.decision.epoch_stats):
+        assert after["samples"] == before["samples"]
+        assert after["metric"] == before["metric"]
+    restored.run()
+    # the resumed epoch closed with FULL totals (64 train + 32 valid)
+    resumed = next(h for h in restored.decision.epoch_history
+                   if h["epoch"] == 1)
+    assert resumed["train"]["samples"] == 64
+    assert resumed["validation"]["samples"] == 32
+
+
 def test_snapshotter_unit_writes_file_and_symlink(tmp_path):
     wf = build(max_epochs=1)
     snap = SnapshotterToFile(wf, directory=str(tmp_path), prefix="mnist",
