@@ -15,7 +15,6 @@ import subprocess
 import sys
 
 import numpy
-import pytest
 
 _WORKER = """
 import json, os, sys
@@ -64,13 +63,17 @@ print("process", pid, "done:", out, flush=True)
 """
 
 
-@pytest.mark.skipif(not os.environ.get("VELES_SLOW"),
-                    reason="two-process multihost run (~1-2 min); "
-                           "run with VELES_SLOW=1")
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_two_process_loopback_training_matches_single(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "mh_worker.py"
-    script.write_text(_WORKER % {"repo": repo, "port": 5731})
+    script.write_text(_WORKER % {"repo": repo, "port": _free_port()})
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = []
