@@ -84,6 +84,55 @@ def test_conv_stride_padding():
     assert u.output.shape == (1, 4, 4, 2)
 
 
+def test_conv_space_to_depth_exact():
+    """space_to_depth is an execution plan, not a different model: the
+    strided conv and its patch-channel restatement must agree exactly
+    (forward AND gradients) across kernel/stride/padding geometries —
+    including the AlexNet conv1 shape it exists for."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.dummy import DummyWorkflow
+
+    local_rng = numpy.random.RandomState(61)  # NOT the shared stream:
+    # sibling tests draw from RNG in file order and are seed-sensitive
+    for side, c, k, s, p in [(51, 3, 11, 4, 2), (16, 4, 4, 4, 0),
+                             (28, 1, 6, 3, 1), (20, 2, 3, 2, "VALID")]:
+        wf = DummyWorkflow()
+        kw = dict(n_kernels=8, kx=k, ky=k, sliding=(s, s), padding=p)
+        plain = Conv(wf, name="plain", **kw)
+        s2d = Conv(wf, name="s2d", space_to_depth=True, **kw)
+        x = jnp.asarray(local_rng.randn(2, side, side, c).astype("f"))
+        params = {
+            "weights": jnp.asarray(
+                (local_rng.randn(k, k, c, 8) * 0.1).astype("f")),
+            "bias": jnp.asarray(local_rng.randn(8).astype("f") * 0.1),
+        }
+        ya, yb = plain.apply(params, x), s2d.apply(params, x)
+        assert ya.shape == yb.shape
+        numpy.testing.assert_allclose(numpy.asarray(ya),
+                                      numpy.asarray(yb), atol=2e-5)
+        ga = jax.grad(lambda pr: float(0) + jnp.sum(
+            plain.apply(pr, x) ** 2))(params)
+        gb = jax.grad(lambda pr: float(0) + jnp.sum(
+            s2d.apply(pr, x) ** 2))(params)
+        for key in ga:
+            numpy.testing.assert_allclose(
+                numpy.asarray(ga[key]), numpy.asarray(gb[key]),
+                atol=5e-4, rtol=1e-4)
+
+
+def test_conv_space_to_depth_rejects_unsupported():
+    from veles_tpu.dummy import DummyWorkflow
+    wf = DummyWorkflow()
+    with pytest.raises(ValueError, match="stride"):
+        Conv(wf, n_kernels=2, kx=3, ky=3, sliding=(1, 1),
+             space_to_depth=True)
+    with pytest.raises(ValueError, match="padding"):
+        Conv(wf, n_kernels=2, kx=3, ky=3, sliding=(2, 2),
+             padding="SAME", space_to_depth=True)
+
+
 def test_max_pooling():
     x = RNG.rand(1, 4, 4, 2).astype(numpy.float32)
     u = wf_with(MaxPooling, x, kx=2, ky=2)

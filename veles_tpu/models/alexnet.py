@@ -14,8 +14,11 @@ from veles_tpu.loader.fullbatch import FullBatchLoader
 from veles_tpu.standard_workflow import StandardWorkflow
 
 ALEXNET_LAYERS = [
+    # space_to_depth: exact same math, executed as a stride-1 conv on
+    # 4x4-patch channels — the 3-channel input otherwise wastes the
+    # MXU's reduction depth (measured −39% conv1 fwd+bwd, docs/PERF.md)
     {"type": "conv_str", "n_kernels": 96, "kx": 11, "ky": 11,
-     "sliding": (4, 4), "padding": 2},
+     "sliding": (4, 4), "padding": 2, "space_to_depth": True},
     {"type": "norm", "n": 5, "alpha": 1e-4, "beta": 0.75},
     {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
     {"type": "conv_str", "n_kernels": 256, "kx": 5, "ky": 5,
