@@ -49,6 +49,19 @@ class TestEnsembleTrainer(unittest.TestCase):
         self.assertNotEqual(argv[argv.index("-s") + 1],
                             argv0[argv0.index("-s") + 1])
 
+    def test_hard_evaluator_death_loses_one_member_not_all(self):
+        """ADVICE r3: a segfaulted/OOM-killed warm evaluator raises
+        RuntimeError from WarmPool.run (after replacing the worker);
+        process_model must record None for that member and continue."""
+        trainer = EnsembleTrainer("wf.py", size=2, warm=True)
+
+        class DeadPool(object):
+            def run(self, argv, result_file=None):
+                raise RuntimeError("evaluator died (exitcode -9)")
+
+        trainer._pool_ = DeadPool()
+        self.assertIsNone(trainer.process_model(0))
+
     def test_validates_arguments(self):
         with self.assertRaises(ValueError):
             EnsembleTrainer("wf.py", size=0)

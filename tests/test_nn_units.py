@@ -96,8 +96,12 @@ def test_conv_space_to_depth_exact():
 
     local_rng = numpy.random.RandomState(61)  # NOT the shared stream:
     # sibling tests draw from RNG in file order and are seed-sensitive
+    # (17, 4, 4, VALID) drops a trailing pixel: s*rows - length - p is
+    # NEGATIVE there (ADVICE r3 medium) — the crop-before-regroup path
+    # must stay exact, not crash in jnp.pad
     for side, c, k, s, p in [(51, 3, 11, 4, 2), (16, 4, 4, 4, 0),
-                             (28, 1, 6, 3, 1), (20, 2, 3, 2, "VALID")]:
+                             (28, 1, 6, 3, 1), (20, 2, 3, 2, "VALID"),
+                             (17, 2, 4, 4, "VALID")]:
         wf = DummyWorkflow()
         kw = dict(n_kernels=8, kx=k, ky=k, sliding=(s, s), padding=p)
         plain = Conv(wf, name="plain", **kw)

@@ -81,6 +81,10 @@ def test_dp_dataset_sharded_not_replicated():
     shard_bytes = data.addressable_shards[0].data.nbytes
     assert shard_bytes * 8 <= data.nbytes + 8 * data.dtype.itemsize * \
         numpy.prod(data.shape[1:])
+    # and the loader's original single-device FULL copy was released
+    # (ADVICE r3: full + 1/N on one device defeats the saving)
+    assert wf.loader.original_data._devmem_ is None
+    assert wf.loader.original_labels._devmem_ is None
     # and the sharded dataset still trains correctly end-to-end
     history = dp.train()
     assert history[-1]["validation"]["normalized"] < \

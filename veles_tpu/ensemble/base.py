@@ -102,6 +102,14 @@ class EnsembleManagerBase(Distributable, IDistributable):
             try:
                 reply = self._get_pool().run(argv,
                                              result_file=result_path)
+            except (RuntimeError, OSError, ValueError) as e:
+                # hard worker death — WarmPool.run's documented raise
+                # set (RuntimeError on exit, OSError on a broken pipe,
+                # ValueError on a truncated reply): the pool already
+                # replaced the worker, so record this member as failed
+                # and keep the rest of the ensemble
+                self.warning("model #%d evaluator died: %s", index, e)
+                return None
             finally:
                 try:
                     os.unlink(result_path)

@@ -161,6 +161,11 @@ class GeneticsOptimizer(Distributable, IDistributable):
             try:
                 reply = self._get_pool().run(argv,
                                              result_file=result_path)
+            except (RuntimeError, OSError, ValueError) as e:
+                # hard evaluator death: keep genetics' raise-on-failure
+                # semantics, but route it through the module's own
+                # failure type (the pool already replaced the worker)
+                raise EvaluationError("fitness evaluator died: %s" % e)
             finally:
                 try:
                     os.unlink(result_path)

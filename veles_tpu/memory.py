@@ -213,6 +213,18 @@ class Array(object):
         if self.mem is not None and not self.mem.flags.writeable:
             self.mem = numpy.array(self.mem)
 
+    def release_devmem(self):
+        """Drop the device buffer (syncing host first if device-dirty).
+
+        The next ``devmem`` access re-uploads, so this is always safe;
+        use when a staged copy supersedes this Array's device residence
+        (e.g. dp row-sharding keeps only 1/N per device — holding the
+        original full copy too would defeat the sharding's HBM saving).
+        """
+        with self._lock_:
+            self.map_read()  # sync host if device-dirty (RLock reenters)
+            self._drop_devmem()
+
     def unmap(self):
         """Flush host writes to the device (upload if dirty)."""
         with self._lock_:

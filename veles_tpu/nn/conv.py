@@ -106,7 +106,13 @@ class Conv(ForwardBase):
 
         out_y, taps_y, rows_y, right_y = geom(h, self.ky)
         out_x, taps_x, rows_x, right_x = geom(wdt, self.kx)
-        xp = jnp.pad(x, [(0, 0), (p, right_y), (p, right_x), (0, 0)])
+        # right can be NEGATIVE when the strided conv drops trailing
+        # pixels (e.g. 17-wide input, kx=4, s=4, VALID): those pixels
+        # are never read by any window, so cropping to s*rows before
+        # the patch regroup is exact — and jnp.pad rejects negatives
+        xp = jnp.pad(x, [(0, 0), (p, max(right_y, 0)),
+                         (p, max(right_x, 0)), (0, 0)])
+        xp = xp[:, :s * rows_y, :s * rows_x, :]
         xs = xp.reshape(n, rows_y, s, rows_x, s, c).transpose(
             0, 1, 3, 2, 4, 5).reshape(n, rows_y, rows_x, s * s * c)
         wp = jnp.pad(w, [(0, taps_y * s - self.ky),

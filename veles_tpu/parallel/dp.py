@@ -62,6 +62,15 @@ class DataParallelTrainer(FusedTrainer):
             return put_global(a, self._data_spec)
 
         self._data_args = tuple(shard_rows(a) for a in self._data_args)
+        # the loader's Arrays still hold the FULL dataset committed to
+        # one device (FusedTrainer.__init__ forced .devmem to build
+        # _data_args) — release those buffers so that device holds only
+        # its 1/N shard, not full + 1/N
+        for arr in (self.loader.original_data,
+                    self.loader.original_labels
+                    if self.loss_kind == "softmax"
+                    else self.loader.original_targets):
+            arr.release_devmem()
 
     def _params_spec(self):
         if self._param_shardings is not None:
