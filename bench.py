@@ -108,11 +108,19 @@ def main():
             w, n_train=n_train, n_valid=batch, side=227, n_classes=1000,
             minibatch_size=batch, dtype="bfloat16"),
         layers=ALEXNET_LAYERS, max_epochs=1)
+    t0 = time.time()
     wf.initialize(device=Device(backend=None))
+    print("loader init (generation): %.0fs" % (time.time() - t0),
+          file=sys.stderr, flush=True)
 
     import numpy
 
-    trainer = FusedTrainer(wf)
+    t0 = time.time()
+    trainer = FusedTrainer(
+        wf, stage_s2d=os.environ.get("VELES_BENCH_STAGE_S2D", "1") != "0")
+    print("trainer build (incl. s2d staging upload): %.0fs, staged=%s"
+          % (time.time() - t0, trainer._staged_s2d),
+          file=sys.stderr, flush=True)
     params, states = trainer.pull_params()
     # host-side snapshot of the fresh model: the warmup DONATES these
     # device buffers, so the timed window re-uploads from here to start
@@ -127,12 +135,14 @@ def main():
     # second absorbs the one-time donated-buffer re-layout so the timed
     # region is pure steady state
     t_compile = time.time()
-    for _ in range(2):
+    for i in range(2):
         params, states, losses, _ = trainer._train_segment(
             params, states, idx, keys)
         float(losses[-1])
+        print("warmup segment %d done: %.1fs" % (i, time.time() - t_compile),
+              file=sys.stderr, flush=True)
     print("warmup (compile + settle): %.1fs" % (time.time() - t_compile),
-          file=sys.stderr)
+          file=sys.stderr, flush=True)
 
     # -- phase 1 (untimed): LIVE-LOSS evidence. Restart from the fresh
     # model and read the loss after every epoch — the descent from

@@ -85,6 +85,62 @@ class Conv(ForwardBase):
         y = jax.eval_shape(self.apply, {"weights": w}, x)
         return (input_shape[0],) + tuple(y.shape[1:])
 
+    def _s2d_geom(self, length, k):
+        """(out, taps, rows, right_pad) of the patch-channel regroup
+        along one spatial axis. ``right_pad`` can be negative when the
+        strided conv drops trailing pixels — callers crop, not pad."""
+        s = self.sliding[0]
+        p = self.padding if isinstance(self.padding, int) else 0
+        out = (length + 2 * p - k) // s + 1
+        taps = -(-k // s)
+        rows = out + taps - 1
+        return out, taps, rows, s * rows - length - p
+
+    def s2d_pack_input(self, x):
+        """(n, h, w, c) -> (n, rows_y, rows_x, s*s*c) patch channels.
+
+        Row-wise and linear, so it commutes with minibatch gathering
+        and zero-masking — which is what lets a fullbatch dataset be
+        packed ONCE at staging time (FusedTrainer) instead of per step.
+        """
+        if x.ndim == 3:
+            x = x[..., None]
+        s = self.sliding[0]
+        p = self.padding if isinstance(self.padding, int) else 0
+        n, h, wdt, c = x.shape
+        _, _, rows_y, right_y = self._s2d_geom(h, self.ky)
+        _, _, rows_x, right_x = self._s2d_geom(wdt, self.kx)
+        # right can be NEGATIVE when the strided conv drops trailing
+        # pixels (e.g. 17-wide input, kx=4, s=4, VALID): those pixels
+        # are never read by any window, so cropping to s*rows before
+        # the patch regroup is exact — and jnp.pad rejects negatives
+        xp = jnp.pad(x, [(0, 0), (p, max(right_y, 0)),
+                         (p, max(right_x, 0)), (0, 0)])
+        xp = xp[:, :s * rows_y, :s * rows_x, :]
+        return xp.reshape(n, rows_y, s, rows_x, s, c).transpose(
+            0, 1, 3, 2, 4, 5).reshape(n, rows_y, rows_x, s * s * c)
+
+    def s2d_packed_shape(self, input_shape):
+        """Per-sample packed shape for a raw (h, w[, c]) sample shape."""
+        h, wdt = input_shape[0], input_shape[1]
+        c = input_shape[2] if len(input_shape) > 2 else 1
+        s = self.sliding[0]
+        _, _, rows_y, _ = self._s2d_geom(h, self.ky)
+        _, _, rows_x, _ = self._s2d_geom(wdt, self.kx)
+        return (rows_y, rows_x, s * s * c)
+
+    def _s2d_pack_weights(self, w):
+        """(ky, kx, c, o) -> (taps_y, taps_x, s*s*c, o): the kernel
+        regrouped (zero-extended to whole taps) to match packed input."""
+        s = self.sliding[0]
+        _, taps_y, _, _ = self._s2d_geom(0, self.ky)
+        _, taps_x, _, _ = self._s2d_geom(0, self.kx)
+        c = w.shape[2]
+        wp = jnp.pad(w, [(0, taps_y * s - self.ky),
+                         (0, taps_x * s - self.kx), (0, 0), (0, 0)])
+        return wp.reshape(taps_y, s, taps_x, s, c, -1).transpose(
+            0, 2, 1, 3, 4, 5).reshape(taps_y, taps_x, s * s * c, -1)
+
     def _s2d_conv(self, x, w):
         """Equivalent stride-1 conv on stride x stride patch-channels.
 
@@ -94,34 +150,28 @@ class Conv(ForwardBase):
                    sum_{da,r} xs[i + da, (r, ...)] w2[da, (r, ...)]
         where xs packs each s-row block's rows into channels and w2 is
         the identically-regrouped (zero-extended) kernel."""
-        s = self.sliding[0]
-        p = self.padding if isinstance(self.padding, int) else 0
-        n, h, wdt, c = x.shape
-
-        def geom(length, k):
-            out = (length + 2 * p - k) // s + 1
-            taps = -(-k // s)
-            rows = out + taps - 1
-            return out, taps, rows, s * rows - length - p
-
-        out_y, taps_y, rows_y, right_y = geom(h, self.ky)
-        out_x, taps_x, rows_x, right_x = geom(wdt, self.kx)
-        # right can be NEGATIVE when the strided conv drops trailing
-        # pixels (e.g. 17-wide input, kx=4, s=4, VALID): those pixels
-        # are never read by any window, so cropping to s*rows before
-        # the patch regroup is exact — and jnp.pad rejects negatives
-        xp = jnp.pad(x, [(0, 0), (p, max(right_y, 0)),
-                         (p, max(right_x, 0)), (0, 0)])
-        xp = xp[:, :s * rows_y, :s * rows_x, :]
-        xs = xp.reshape(n, rows_y, s, rows_x, s, c).transpose(
-            0, 1, 3, 2, 4, 5).reshape(n, rows_y, rows_x, s * s * c)
-        wp = jnp.pad(w, [(0, taps_y * s - self.ky),
-                         (0, taps_x * s - self.kx), (0, 0), (0, 0)])
-        w2 = wp.reshape(taps_y, s, taps_x, s, c, -1).transpose(
-            0, 2, 1, 3, 4, 5).reshape(taps_y, taps_x, s * s * c, -1)
         return jax.lax.conv_general_dilated(
-            xs, w2, window_strides=(1, 1), padding="VALID",
+            self.s2d_pack_input(x), self._s2d_pack_weights(w),
+            window_strides=(1, 1), padding="VALID",
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def apply_staged(self, params, xs):
+        """Forward on input ALREADY in ``s2d_pack_input`` layout.
+
+        The fused trainer packs the whole dataset once at staging and
+        calls this for the entry conv, eliminating the per-step
+        rearrange (docs/PERF.md: ~1.5 ms/step on the AlexNet flagship).
+        Float math is identical to ``apply``."""
+        pol = get_policy()
+        xc, wc = pol.cast_in(xs, params["weights"])
+        y = jax.lax.conv_general_dilated(
+            xc, self._s2d_pack_weights(wc), window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = y.astype(pol.accum_dtype)
+        if "bias" in params:
+            y = y + params["bias"]
+        return pol.cast_out(get_activation(self.activation_name)(y))
 
     def apply(self, params, x):
         if x.ndim == 3:

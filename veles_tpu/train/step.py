@@ -44,7 +44,7 @@ from veles_tpu.nn.optim import get_solver
 class FusedTrainer(Logger):
     """Compiles and drives the fused train/eval loop of a workflow."""
 
-    def __init__(self, workflow, donate=True):
+    def __init__(self, workflow, donate=True, stage_s2d=True):
         super(FusedTrainer, self).__init__()
         self.workflow = workflow
         self.loader = workflow.loader
@@ -52,6 +52,8 @@ class FusedTrainer(Logger):
         self.evaluator = workflow.evaluator
         self.decision = workflow.decision
         self.donate = donate
+        self.stage_s2d = stage_s2d
+        self._staged_s2d = False
         # map each forward to its GD unit (for solver + hyper)
         self.gd_for = {}
         for gd in getattr(workflow, "gds", []):
@@ -70,6 +72,15 @@ class FusedTrainer(Logger):
                     sub = jax.random.fold_in(key, i)
                     mask = (jax.random.uniform(sub, x.shape) < keep)
                     x = x * mask.astype(x.dtype) / keep
+            elif i == 0 and self._staged_s2d:
+                # dataset was packed to patch-channel layout at
+                # staging (stored with trailing dims flattened — see
+                # _maybe_stage_s2d); the reshape touches only the
+                # ~40 MB minibatch, then the entry conv consumes it
+                # directly — no per-step rearrange. Numerics identical
+                # to fwd.apply on raw.
+                x = x.reshape((x.shape[0],) + self._staged_sample_shape)
+                x = fwd.apply_staged(params_list[i], x)
             elif is_head:
                 x = fwd.apply_for_grad(params_list[i], x)
             else:
@@ -109,6 +120,77 @@ class FusedTrainer(Logger):
         metric = jnp.sum(jnp.mean(jnp.square(diff), axis=1))
         return grad_loss, metric / n_valid, metric
 
+    def _maybe_stage_s2d(self):
+        """Pack the dataset to patch-channel layout ONCE, if the entry
+        layer is a space-to-depth conv.
+
+        The per-step ``s2d_pack_input`` on the gathered batch costs
+        ~1.5 ms/step on the AlexNet flagship (docs/PERF.md); packing is
+        row-wise and linear, so doing it at staging commutes with the
+        index gather and the invalid-row zero mask — float math is
+        unchanged. Upload happens chunked host->device into a donated
+        buffer, so peak HBM is packed + one chunk (the raw full copy is
+        never resident).
+
+        The packed dataset is stored as (n, rows_y, rows_x*s2c) —
+        each sample's trailing dims flattened to one wide row-major
+        axis. Three measured failure modes force this shape (r4 on
+        v5e; full table in docs/PERF.md):
+
+        * (n, rows_y, rows_x, 48) 4D: XLA relayouts the WHOLE dataset
+          in-program to lane-pad the 48-channel minor dim (2.9x =
+          14.6 GB copy -> compile OOM);
+        * (n, F) flat 2D: the row gather lowers to a one-hot matmul —
+          O(n * mb * F) per step, +16 ms/step at n=16k (the whole
+          dataset re-read every step);
+        * (n, F/128, 128) lane-aligned 3D: generic scalar-core gather
+          of many tiny slices, +23 ms/step.
+
+        The wide row-major 3D shape gathers as per-row DMA slices
+        (like the raw 4D dataset always did) with ~zero tile padding;
+        the per-minibatch reshape back to NHWC touches only ~40 MB
+        inside the step. Returns the packed ``jax.Array`` or None;
+        per-sample shape lands in ``self._staged_sample_shape``.
+        """
+        from veles_tpu.nn.conv import Conv
+        fwd0 = self.forwards[0] if self.forwards else None
+        if (not self.stage_s2d or len(self.forwards) < 2 or
+                not isinstance(fwd0, Conv) or
+                not getattr(fwd0, "space_to_depth", False)):
+            return None
+        raw = self.loader.original_data.map_read()
+        n = raw.shape[0]
+        packed_sample = fwd0.s2d_packed_shape(raw.shape[1:])
+        self._staged_sample_shape = packed_sample
+        flat = int(numpy.prod(packed_sample))
+        ry = packed_sample[0]
+        inner = flat // ry
+
+        def pack_flat(chunk):
+            return fwd0.s2d_pack_input(chunk).reshape(
+                chunk.shape[0], ry, inner)
+
+        update = jax.jit(
+            lambda buf, chunk, start: jax.lax.dynamic_update_slice(
+                buf, pack_flat(chunk), (start, 0, 0)),
+            donate_argnums=(0,))
+        packed = jnp.zeros((n, ry, inner), dtype=raw.dtype)
+        chunk = max(1, min(n, 512))
+        for i, start in enumerate(range(0, n, chunk)):
+            piece = jnp.asarray(raw[start:start + chunk])
+            packed = update(packed, piece, start)
+            if i % 8 == 7:
+                # the TPU relay rejects deep async queues (>~20 in
+                # flight); periodically drain before enqueuing more
+                packed.block_until_ready()
+        packed.block_until_ready()
+        # the raw full copy must not ALSO sit on the device (some
+        # eager path may have uploaded it before the fused build)
+        self.loader.original_data.release_devmem()
+        self.debug("staged space-to-depth dataset: %s -> %s",
+                   raw.shape, packed.shape)
+        return packed
+
     @staticmethod
     def _gather(data_args, idx):
         dataset, truth_src = data_args
@@ -142,8 +224,11 @@ class FusedTrainer(Logger):
         # program by the whole dataset (hundreds of MB for ImageNet
         # shapes — enough to kill remote-compile services) and (b)
         # defeats donation/sharding of the dataset buffer.
+        staged = self._maybe_stage_s2d()
+        self._staged_s2d = staged is not None
         self._data_args = (
-            self.loader.original_data.devmem,
+            staged if staged is not None
+            else self.loader.original_data.devmem,
             self.loader.original_labels.devmem
             if self.loss_kind == "softmax"
             else self.loader.original_targets.devmem)
