@@ -196,3 +196,24 @@ def test_pallas_kahan_gemm_matches_loop_kahan():
     numpy.testing.assert_allclose(fused, loop, rtol=1e-6, atol=1e-4)
     via_gemm = numpy.asarray(gemm(a, b, precision_level=1))
     numpy.testing.assert_allclose(via_gemm, loop, rtol=1e-6, atol=1e-4)
+
+
+class TestSolverState(object):
+    def test_sgd_state_structure_mirrors_input(self):
+        """A pre-r4 snapshot's opt_state has no 'step' counter; the
+        update must not add one (the lax.scan carry pytree would
+        change structure mid-resume). Fresh init-built state carries
+        and advances it."""
+        import jax.numpy as jnp
+        from veles_tpu.nn.optim import get_solver
+        sgd = get_solver("sgd")
+        params = {"w": jnp.ones((3,))}
+        grads = {"w": jnp.ones((3,))}
+        hp = {"learning_rate": 0.1}
+        fresh = sgd.init(params)
+        assert "step" in fresh
+        _, out = sgd.update(params, grads, fresh, hp)
+        assert float(out["step"]) == 1.0
+        legacy = {"velocity": {"w": jnp.zeros((3,))}}
+        _, out = sgd.update(params, grads, legacy, hp)
+        assert set(out) == {"velocity"}

@@ -109,3 +109,92 @@ class golden_digits(object):
         state = dict(self.__dict__)
         state["_cache_"] = None
         return state
+
+
+def _render_object(klass, rng, size=32):
+    """One 32x32x3 'golden objects' sample: a procedural SHAPE on a
+    random background. Hues are random PER SAMPLE (never per class), so
+    color carries no class signal — the classifier must read shape,
+    which is what keeps the analog non-trivial for a convnet and
+    hopeless for color-histogram shortcuts."""
+    yy, xx = numpy.mgrid[0:size, 0:size].astype(numpy.float32)
+    cy = size / 2 + rng.uniform(-4, 4)
+    cx = size / 2 + rng.uniform(-4, 4)
+    r = rng.uniform(6, 10)
+    dy, dx = yy - cy, xx - cx
+    theta = rng.uniform(0, numpy.pi)
+    ry = dy * numpy.cos(theta) - dx * numpy.sin(theta)
+    rx = dy * numpy.sin(theta) + dx * numpy.cos(theta)
+    if klass == 0:      # disc
+        mask = (dy ** 2 + dx ** 2) < r ** 2
+    elif klass == 1:    # filled square (rotated)
+        mask = numpy.maximum(abs(ry), abs(rx)) < r * 0.8
+    elif klass == 2:    # triangle
+        mask = (ry > -r * 0.6) & (abs(rx) < (r * 0.8 - ry) * 0.6)
+    elif klass == 3:    # ring
+        d2 = dy ** 2 + dx ** 2
+        mask = (d2 < r ** 2) & (d2 > (r * 0.55) ** 2)
+    elif klass == 4:    # cross
+        mask = ((abs(ry) < r * 0.3) | (abs(rx) < r * 0.3)) & \
+            (numpy.maximum(abs(ry), abs(rx)) < r)
+    elif klass == 5:    # stripes along the rotated axis
+        mask = (numpy.sin(ry * numpy.pi / rng.uniform(2.5, 4.0)) > 0) & \
+            ((dy ** 2 + dx ** 2) < (r * 1.3) ** 2)
+    elif klass == 6:    # checkerboard patch
+        mask = ((numpy.sin(ry * 1.1) > 0) ^ (numpy.sin(rx * 1.1) > 0)) & \
+            (numpy.maximum(abs(ry), abs(rx)) < r)
+    elif klass == 7:    # two discs
+        off = r * 0.75
+        mask = ((dy - off) ** 2 + (dx) ** 2 < (r * 0.55) ** 2) | \
+            ((dy + off) ** 2 + (dx) ** 2 < (r * 0.55) ** 2)
+    elif klass == 8:    # hollow square frame
+        m = numpy.maximum(abs(ry), abs(rx))
+        mask = (m < r * 0.9) & (m > r * 0.5)
+    else:               # crescent: disc minus shifted disc
+        d2 = dy ** 2 + dx ** 2
+        mask = (d2 < r ** 2) & \
+            ((dy - r * 0.5) ** 2 + (dx - r * 0.3) ** 2 > (r * 0.85) ** 2)
+    fg = rng.uniform(0.2, 1.0, 3).astype(numpy.float32)
+    bg = rng.uniform(0.0, 0.8, 3).astype(numpy.float32)
+    # guarantee some figure/ground contrast or the shape can vanish
+    while float(numpy.abs(fg - bg).max()) < 0.3:
+        fg = rng.uniform(0.2, 1.0, 3).astype(numpy.float32)
+        bg = rng.uniform(0.0, 0.8, 3).astype(numpy.float32)
+    img = numpy.where(mask[..., None], fg, bg).astype(numpy.float32)
+    # distractor bar (never class-informative: same for all classes)
+    if rng.rand() < 0.5:
+        y0 = rng.randint(0, size - 3)
+        img[y0:y0 + 2, :, :] = rng.uniform(0, 1, 3)
+    img += rng.normal(0, 0.18, img.shape).astype(numpy.float32)
+    return numpy.clip(img, 0.0, 1.0)
+
+
+class golden_objects(object):
+    """CIFAR-shaped committed analog (VERDICT r3 missing #4): 10
+    procedural shape classes at 32x32x3, deterministic from ``seed``.
+    Same provider contract and caching behavior as golden_digits."""
+
+    def __init__(self, n_train=10000, n_valid=2000, seed=2027, size=32):
+        self.n_train = n_train
+        self.n_valid = n_valid
+        self.seed = seed
+        self.size = size
+        self._cache_ = None
+
+    def __call__(self):
+        if self._cache_ is None:
+            rng = numpy.random.RandomState(self.seed)
+            total = self.n_train + self.n_valid
+            labels = rng.randint(0, 10, total).astype(numpy.int32)
+            images = numpy.stack([_render_object(int(lbl), rng, self.size)
+                                  for lbl in labels])
+            self._cache_ = (images[:self.n_train],
+                            labels[:self.n_train],
+                            images[self.n_train:],
+                            labels[self.n_train:])
+        return self._cache_
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_cache_"] = None
+        return state

@@ -73,7 +73,8 @@ class MnistWorkflow(AcceleratedWorkflow):
 
     def __init__(self, workflow=None, provider=None, layers=(100,),
                  minibatch_size=60, learning_rate=0.1, weights_decay=0.0,
-                 max_epochs=None, fail_iterations=100, **kwargs):
+                 momentum=0.0, lr_decay=1.0, max_epochs=None,
+                 fail_iterations=100, **kwargs):
         super(MnistWorkflow, self).__init__(workflow, **kwargs)
 
         self.repeater = Repeater(self)
@@ -129,7 +130,9 @@ class MnistWorkflow(AcceleratedWorkflow):
         for fwd in reversed(self.forwards):
             gd_cls = GDSoftmax if fwd is head else GDTanh
             gd = gd_cls(self, forward=fwd, learning_rate=learning_rate,
-                        weights_decay=weights_decay,
+                        weights_decay=weights_decay, momentum=momentum,
+                        solver_hp={"lr_decay": lr_decay}
+                        if lr_decay != 1.0 else {},
                         need_err_input=fwd is not self.forwards[0],
                         name="gd_" + fwd.name)
             gd.link_from(self.gds[-1] if self.gds else self.decision)

@@ -38,25 +38,41 @@ class Solver(object):
 
 class SGD(Solver):
     """lr * grad with classical momentum and L2 weight decay —
-    the reference's default GradientDescent rule."""
+    the reference's default GradientDescent rule.
+
+    ``hp['lr_decay']`` (optional, default 1.0) multiplies the learning
+    rate by ``lr_decay**step`` — the classic exponential schedule; it
+    rides a step counter in the solver state so the whole schedule jits
+    into one compiled train segment (no per-epoch recompiles)."""
 
     name = "sgd"
 
     @staticmethod
     def init(params):
-        return {"velocity": _zeros_like(params)}
+        return {"velocity": _zeros_like(params),
+                "step": jnp.zeros((), jnp.float32)}
 
     @staticmethod
     def update(params, grads, state, hp):
         wd = hp.get("weight_decay", 0.0)
         mom = hp.get("momentum", 0.0)
+        step = state.get("step", 0.0)
+        scale = jnp.power(hp["lr_decay"], step) \
+            if hp.get("lr_decay", 1.0) != 1.0 else 1.0
         new_p, new_v = {}, {}
         for k, p in params.items():
             g = grads[k] + wd * p
-            v = mom * state["velocity"][k] - _lr_for(hp, k) * g
+            v = mom * state["velocity"][k] - _lr_for(hp, k) * scale * g
             new_p[k] = p + v
             new_v[k] = v
-        return new_p, {"velocity": new_v}
+        new_state = {"velocity": new_v}
+        if "step" in state:
+            # output structure must MIRROR the input's: a pre-r4
+            # snapshot's state has no counter, and adding one here
+            # would break the lax.scan carry pytree (such snapshots
+            # predate lr_decay, so the schedule loses nothing)
+            new_state["step"] = step + 1.0
+        return new_p, new_state
 
 
 class AdaGrad(Solver):

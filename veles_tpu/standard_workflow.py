@@ -62,9 +62,9 @@ class StandardWorkflow(AcceleratedWorkflow):
 
     def __init__(self, workflow=None, loader=None, layers=(),
                  loss="softmax", learning_rate=0.01, weights_decay=0.0,
-                 momentum=0.0, solver="sgd", max_epochs=None,
-                 fail_iterations=100, mse_target_attr="minibatch_data",
-                 **kwargs):
+                 momentum=0.0, lr_decay=1.0, solver="sgd",
+                 max_epochs=None, fail_iterations=100,
+                 mse_target_attr="minibatch_data", **kwargs):
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         if loader is None:
             raise ValueError("StandardWorkflow needs a loader factory")
@@ -145,6 +145,8 @@ class StandardWorkflow(AcceleratedWorkflow):
                                                 weights_decay),
                         momentum=hyper.get("momentum", momentum),
                         solver=solver,
+                        solver_hp={"lr_decay": lr_decay}
+                        if lr_decay != 1.0 else {},
                         need_err_input=fwd is not self.forwards[0],
                         name="gd_" + fwd.name)
             gd.link_from(self.gds[-1] if self.gds else self.decision)
