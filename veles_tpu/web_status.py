@@ -41,6 +41,7 @@ th { background: #eee; }
 <h1>veles_tpu workflows</h1>
 <p><a href="/workflow.html">graph view</a> ·
 <a href="/timeline.html">event timeline</a> ·
+<a href="/slaves.html">slave stats</a> ·
 <a href="/logs.html">logs</a> ·
 <a href="/frontend.html">command composer</a></p>
 <table id="wf"><thead><tr>
@@ -68,6 +69,46 @@ async function refresh() {
       tr.appendChild(td);
     }
     tbody.appendChild(tr);
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+_SLAVES_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu slave stats</title><style>
+body { font-family: sans-serif; margin: 2em; background: #fafafa; }
+table { border-collapse: collapse; min-width: 60em; }
+th, td { border: 1px solid #ccc; padding: 0.4em 0.8em; text-align: left; }
+th { background: #eee; }
+.stale { color: #b00; }
+</style></head><body>
+<h1>slave stats</h1>
+<p><a href="/status.html">&larr; workflows</a></p>
+<table id="sl"><thead><tr>
+<th>master</th><th>slave</th><th>state</th><th>power</th>
+<th>jobs done</th><th>in flight</th><th>last seen (s)</th>
+</tr></thead><tbody></tbody></table>
+<script>
+async function refresh() {
+  const resp = await fetch("/service", {method: "POST",
+    headers: {"Content-Type": "application/json"},
+    body: JSON.stringify({request: "workflows",
+      args: ["name", "slaves"]})});
+  const data = await resp.json();
+  const tbody = document.querySelector("#sl tbody");
+  tbody.innerHTML = "";
+  for (const [mid, wf] of Object.entries(data.result || {})) {
+    for (const [sid, s] of Object.entries(wf.slaves || {})) {
+      const tr = document.createElement("tr");
+      if ((s.age || 0) > 10) tr.className = "stale";
+      for (const v of [wf.name || mid.slice(0, 8), sid, s.state,
+                       s.power, s.jobs_done, s.in_flight, s.age]) {
+        const td = document.createElement("td");
+        td.textContent = v === undefined ? "" : String(v);
+        tr.appendChild(td);
+      }
+      tbody.appendChild(tr);
+    }
   }
 }
 refresh(); setInterval(refresh, 2000);
@@ -461,6 +502,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(_STATUS_PAGE, ctype="text/html; charset=utf-8")
         elif self.path.startswith("/logs.html"):
             self._reply(_LOGS_PAGE, ctype="text/html; charset=utf-8")
+        elif self.path.startswith("/slaves.html"):
+            self._reply(_SLAVES_PAGE, ctype="text/html; charset=utf-8")
         elif self.path.startswith("/frontend.html"):
             self._reply(_FRONTEND_PAGE, ctype="text/html; charset=utf-8")
         elif self.path.startswith("/workflow.html"):
