@@ -86,6 +86,40 @@ class Workflow(Container):
     def index_of(self, unit):
         return self._units.index(unit)
 
+    def change_unit(self, old, new_unit, save_gates=True):
+        """Swap a unit in an already-linked (possibly snapshot-restored)
+        graph, preserving its control links.
+
+        The reference's ``Workflow.change_unit``
+        (``veles/workflow.py:977-1051``) is what made its
+        snapshot-then-modify loop usable: restore, replace one unit
+        (typically the decision), resume. ``old`` is a unit or its
+        name; ``new_unit`` takes over every control link into and out
+        of ``old`` and (with ``save_gates``) its gate objects. Data
+        links (``link_attrs``) and gate EXPRESSIONS other units built
+        from the old unit's Bools (e.g. ``repeater.gate_block =
+        decision.complete``) reference live objects and must be re-made
+        by the caller — same contract as the reference, which left its
+        "data links transmission" TODO unresolved. Returns ``new_unit``.
+        """
+        old_unit = self[old] if isinstance(old, str) else old
+        if old_unit is new_unit:
+            return new_unit
+        sources = list(old_unit.links_from)
+        dependents = list(old_unit.links_to)
+        gate_block, gate_skip = old_unit.gate_block, old_unit.gate_skip
+        old_unit.unlink_all()
+        self.del_ref(old_unit)
+        self.add_ref(new_unit)
+        if sources:
+            new_unit.link_from(*sources)
+        for dst in dependents:
+            dst.link_from(new_unit)
+        if save_gates:
+            new_unit.gate_block = gate_block
+            new_unit.gate_skip = gate_skip
+        return new_unit
+
     def __iter__(self):
         return iter(self._units)
 
