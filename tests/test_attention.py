@@ -153,3 +153,24 @@ def test_sequence_workflow_trains_fused():
     best = min(h["validation"]["normalized"]
                for h in wf.decision.epoch_history)
     assert best <= 0.12, best
+
+
+def test_sequence_workflow_with_moe_trains():
+    """The moe=True variant (attention -> expert FFN -> attention)
+    trains fused too — the MoE layer differentiates through the step
+    compiler like any other Znicz layer."""
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.models.samples import SequenceWorkflow
+
+    prng._generators.clear()
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    launcher = Launcher(graphics=False)
+    wf = SequenceWorkflow(launcher, max_epochs=12, moe=True)
+    launcher.initialize()
+    launcher.run()
+    assert launcher.run_mode_used == "fused"
+    assert type(wf.forwards[1]).__name__ == "MoEForward"
+    best = min(h["validation"]["normalized"]
+               for h in wf.decision.epoch_history)
+    assert best <= 0.15, best

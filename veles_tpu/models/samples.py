@@ -301,25 +301,33 @@ class SequenceWorkflow(StandardWorkflow):
     long-context building block as a full training workflow — runs
     FUSED through the same step compiler as every other sample, and
     each attention layer can switch to ring attention on a seq mesh
-    (``MultiHeadAttentionForward.use_ring``)."""
+    (``MultiHeadAttentionForward.use_ring``). ``moe=True`` inserts a
+    Switch-style expert FFN between the attention layers
+    (``MoEForward.use_experts`` shards it over an expert mesh)."""
 
     hide_from_registry = True
 
     def __init__(self, workflow=None, provider=None, minibatch_size=80,
-                 heads=4, n_classes=8, **kwargs):
+                 heads=4, n_classes=8, moe=False, n_experts=4,
+                 **kwargs):
         provider = provider or SequenceProvider(n_classes=n_classes)
         kwargs.setdefault("learning_rate", 0.1)
         kwargs.setdefault("loss", "softmax")
+        layers = [
+            {"type": "attention", "heads": heads, "causal": False},
+        ]
+        if moe:
+            layers.append({"type": "moe", "n_experts": n_experts})
+        layers += [
+            {"type": "attention", "heads": heads, "causal": False},
+            {"type": "softmax", "output_sample_shape": n_classes},
+        ]
         super(SequenceWorkflow, self).__init__(
             workflow,
             loader=lambda w: TabularLoader(
                 w, provider=provider, minibatch_size=minibatch_size,
                 sequence=True, normalization_type="none"),
-            layers=[
-                {"type": "attention", "heads": heads, "causal": False},
-                {"type": "attention", "heads": heads, "causal": False},
-                {"type": "softmax", "output_sample_shape": n_classes},
-            ], **kwargs)
+            layers=layers, **kwargs)
 
 
 class LinesWorkflow(StandardWorkflow):

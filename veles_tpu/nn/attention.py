@@ -55,40 +55,11 @@ class MultiHeadAttentionForward(ForwardBase):
         self._seq_mesh_ = None
         self._seq_axis_ = "seq"
 
-    def param_values(self):
-        """With a seq mesh attached, committed single-device parameter
-        buffers must be re-placed onto the mesh (replicated) or the
-        ring's shard_map rejects the device-set mismatch."""
-        params = super(MultiHeadAttentionForward, self).param_values()
-        if self._seq_mesh_ is not None:
-            import jax
-
-            from veles_tpu.parallel.mesh import named_sharding
-            repl = named_sharding(self._seq_mesh_)
-            params = {k: jax.device_put(v, repl)
-                      for k, v in params.items()}
-        return params
-
-    def _input_devmem(self):
-        return self.place_for_grad(
-            super(MultiHeadAttentionForward, self)._input_devmem())
-
-    def place_for_grad(self, tree):
-        """Re-place committed single-device arrays (inputs, err_output,
-        optimizer state) onto the seq mesh, replicated — uncommitted
-        host arrays pass through untouched."""
-        if self._seq_mesh_ is None:
-            return tree
-        import jax
-
-        from veles_tpu.parallel.mesh import named_sharding
-        repl = named_sharding(self._seq_mesh_)
-
-        def place(v):
-            return jax.device_put(v, repl) if hasattr(v, "sharding") \
-                else v
-
-        return jax.tree_util.tree_map(place, tree)
+    def _placement_mesh(self):
+        # base place_for_grad/param_values/_input_devmem re-place every
+        # committed buffer onto the seq mesh (the ring's shard_map
+        # rejects device-set mismatches otherwise)
+        return self._seq_mesh_
 
     def weights_shape_for(self, input_shape):
         dim = input_shape[-1]

@@ -69,12 +69,33 @@ class ForwardBase(AcceleratedUnit):
         evaluator seeds the gradient w.r.t. logits)."""
         return self.apply(params, x)
 
+    def _placement_mesh(self):
+        """Mesh this unit's ``apply`` runs on, or None. Units whose
+        forward is a shard_map (ring attention's seq mesh, MoE's expert
+        mesh) return the attached mesh; everything that touches the
+        compiled step — params, inputs, err_output, optimizer state —
+        is then re-placed onto it (replicated), because a committed
+        single-device buffer fails the shard_map's device-set check."""
+        return None
+
     def place_for_grad(self, tree):
-        """Hook for units whose ``apply`` runs on a device mesh: the
-        paired GD step routes its other inputs (err_output, optimizer
-        state) through here so committed single-device buffers can be
-        re-placed to match. Identity by default."""
-        return tree
+        """Re-place committed single-device arrays onto the unit's
+        mesh, replicated — identity when no mesh is attached;
+        uncommitted host arrays pass through untouched. The paired GD
+        step routes err_output / optimizer state through here."""
+        mesh = self._placement_mesh()
+        if mesh is None:
+            return tree
+        import jax
+
+        from veles_tpu.parallel.mesh import named_sharding
+        repl = named_sharding(mesh)
+
+        def place(v):
+            return jax.device_put(v, repl) if hasattr(v, "sharding") \
+                else v
+
+        return jax.tree_util.tree_map(place, tree)
 
     # -- parameter handling ------------------------------------------------
 
@@ -97,13 +118,14 @@ class ForwardBase(AcceleratedUnit):
                 rng.fill(self.bias.mem, -bstd, bstd)
 
     def param_values(self):
-        """Device-side parameter pytree for ``apply``."""
+        """Device-side parameter pytree for ``apply`` (re-placed onto
+        the unit's mesh when one is attached)."""
         params = {}
         if self.has_weights:
             params["weights"] = self.weights.devmem
             if self.include_bias:
                 params["bias"] = self.bias.devmem
-        return params
+        return self.place_for_grad(params)
 
     def param_arrays(self):
         out = {}
@@ -139,8 +161,9 @@ class ForwardBase(AcceleratedUnit):
     # -- execution ---------------------------------------------------------
 
     def _input_devmem(self):
-        return (self.input.devmem if isinstance(self.input, Array)
-                else self.input)
+        return self.place_for_grad(
+            self.input.devmem if isinstance(self.input, Array)
+            else self.input)
 
     def jax_run(self):
         self.unmap_vectors(self.input, self.weights, self.bias)

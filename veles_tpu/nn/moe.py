@@ -1,0 +1,117 @@
+"""Mixture-of-experts FFN unit (``{"type": "moe"}`` layer).
+
+Wraps :func:`veles_tpu.parallel.ep.moe_ffn` the way the attention unit
+wraps ring attention: a plain ForwardBase whose ``apply`` is pure, so
+the fused step compiler, the eager scheduler, and the generic vjp GD
+unit all drive it unchanged. Without a mesh it computes the dense
+single-device math; ``use_experts(mesh)`` switches to the
+expert-parallel all_to_all schedule (transient state — reattach after
+snapshot resume, like ``MultiHeadAttentionForward.use_ring``).
+
+The 2015 reference predates MoE; this extends the Znicz layer family
+per the task brief's first-class-parallelism requirement.
+"""
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.memory import Array
+from veles_tpu.nn.base import ForwardBase
+
+
+class MoEForward(ForwardBase):
+    """Switch-style top-1 MoE FFN over (batch, seq, dim) or (n, dim).
+
+    Parameters: ``weights`` is the ROUTER (dim, n_experts) — reusing
+    the base class's allocation/filling — plus per-expert ``up``
+    (E, dim, hidden) and ``down`` (E, hidden, dim) stacks.
+    """
+
+    def __init__(self, workflow, n_experts=8, hidden=None,
+                 capacity_factor=1.25, residual=True, **kwargs):
+        kwargs.setdefault("include_bias", False)
+        super(MoEForward, self).__init__(workflow, **kwargs)
+        self.n_experts = int(n_experts)
+        self.hidden = hidden  # default: 4 * dim, set at initialize
+        self.capacity_factor = float(capacity_factor)
+        self.residual = residual
+        self.up = Array()
+        self.down = Array()
+        self._ep_mesh_ = None
+        self._ep_axis_ = "expert"
+
+    def use_experts(self, mesh, axis="expert"):
+        """Attach an expert mesh: apply() switches to the all_to_all
+        expert-parallel schedule (per-shard capacity semantics)."""
+        if mesh.shape[axis] != self.n_experts:
+            raise ValueError(
+                "%d experts cannot shard over a %d-wide %r axis" %
+                (self.n_experts, mesh.shape[axis], axis))
+        self._ep_mesh_ = mesh
+        self._ep_axis_ = axis
+        return self
+
+    def init_unpickled(self):
+        super(MoEForward, self).init_unpickled()
+        self._ep_mesh_ = None
+        self._ep_axis_ = "expert"
+
+    def _placement_mesh(self):
+        # base place_for_grad/param_values/_input_devmem re-place every
+        # committed buffer onto the expert mesh (the all_to_all
+        # shard_map rejects device-set mismatches otherwise)
+        return self._ep_mesh_
+
+    def weights_shape_for(self, input_shape):
+        return (input_shape[-1], self.n_experts)
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def initialize(self, device=None, **kwargs):
+        super(MoEForward, self).initialize(device=device, **kwargs)
+        dim = self.input_shape[-1]
+        if self.hidden is None:
+            self.hidden = 4 * dim
+        if self.up.mem is None:
+            rng = prng.get(self.rand_name)
+            stddev = 1.0 / numpy.sqrt(dim)
+            self.up.reset(numpy.zeros(
+                (self.n_experts, dim, self.hidden), numpy.float32))
+            rng.fill(self.up.mem, -stddev, stddev)
+            stddev = 1.0 / numpy.sqrt(self.hidden)
+            self.down.reset(numpy.zeros(
+                (self.n_experts, self.hidden, dim), numpy.float32))
+            rng.fill(self.down.mem, -stddev, stddev)
+        self.init_vectors(self.up, self.down)
+
+    def param_arrays(self):
+        out = super(MoEForward, self).param_arrays()
+        out["up"] = self.up
+        out["down"] = self.down
+        return out
+
+    def param_values(self):
+        out = super(MoEForward, self).param_values()
+        out.update(self.place_for_grad({"up": self.up.devmem,
+                                        "down": self.down.devmem}))
+        return out
+
+    def apply(self, params, x):
+        from veles_tpu.parallel.ep import moe_ffn, moe_ffn_reference
+
+        tokens = x.reshape(-1, x.shape[-1])
+        if self._ep_mesh_ is not None:
+            y = moe_ffn(tokens, params["weights"], params["up"],
+                        params["down"], self._ep_mesh_, self._ep_axis_,
+                        capacity_factor=self.capacity_factor)
+        else:
+            y = moe_ffn_reference(tokens, params["weights"],
+                                  params["up"], params["down"],
+                                  self.n_experts,
+                                  capacity_factor=self.capacity_factor,
+                                  n_shards=1)
+        y = y.reshape(x.shape)
+        if self.residual:
+            y = y + x
+        return y.astype(x.dtype)

@@ -24,4 +24,6 @@ plane becomes XLA collectives over ICI/DCN under a single controller:
 from veles_tpu.parallel.mesh import (build_mesh, local_device_count,  # noqa
                                      named_sharding)
 from veles_tpu.parallel.dp import DataParallelTrainer  # noqa: F401
-from veles_tpu.parallel.sequence import ring_attention  # noqa: F401
+from veles_tpu.parallel.ep import moe_ffn  # noqa: F401
+from veles_tpu.parallel.sequence import (ring_attention,  # noqa: F401
+                                         ulysses_attention)

@@ -19,6 +19,7 @@ from veles_tpu.nn.all2all import (All2All, All2AllRELU, All2AllSigmoid,
                                   All2AllSoftmax, All2AllStrictRELU,
                                   All2AllTanh)
 from veles_tpu.nn.attention import MultiHeadAttentionForward
+from veles_tpu.nn.moe import MoEForward
 from veles_tpu.nn.conv import (Conv, ConvRELU, ConvSigmoid,
                                ConvStrictRELU, ConvTanh, Deconv)
 from veles_tpu.nn.decision import DecisionGD, DecisionMSE
@@ -52,6 +53,7 @@ LAYER_TYPES = {
     "dropout": DropoutForward,
     "activation": ActivationUnit,
     "attention": MultiHeadAttentionForward,
+    "moe": MoEForward,
 }
 
 
