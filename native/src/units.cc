@@ -505,6 +505,123 @@ class AttentionUnit : public Unit {
   bool causal_ = false, residual_ = true;
 };
 
+// ------------------------------------------------------------------ MoE
+
+// Switch-style top-1 mixture-of-experts FFN; numerics mirror
+// veles_tpu/nn/moe.py::MoEForward's dense path: capacity pools PER
+// SAMPLE (batch-composition-independent inference), first-come
+// capacity, strict-relu hidden, gate-probability scaled output,
+// optional residual.
+class MoEUnit : public Unit {
+ public:
+  const char* Name() const override { return "MoE"; }
+
+  Shape Initialize(const Shape& input_shape) override {
+    if (input_shape.empty()) {
+      throw std::runtime_error("moe needs (..., dim) samples");
+    }
+    dim_ = input_shape.back();
+    tokens_per_sample_ = 1;
+    for (size_t i = 0; i + 1 < input_shape.size(); ++i) {
+      tokens_per_sample_ *= input_shape[i];
+    }
+    n_experts_ = static_cast<int64_t>(Param("n_experts", 0));
+    const NpyArray* router = Array("weights");
+    if (router == nullptr || router->shape.size() != 2 ||
+        router->shape[0] != dim_ || router->shape[1] != n_experts_) {
+      throw std::runtime_error("moe needs (dim, n_experts) router");
+    }
+    const NpyArray* up = Array("up");
+    if (up == nullptr || up->shape.size() != 3 ||
+        up->shape[0] != n_experts_ || up->shape[1] != dim_) {
+      throw std::runtime_error("moe needs (E, dim, hidden) up");
+    }
+    hidden_ = up->shape[2];
+    const NpyArray* down = Array("down");
+    if (down == nullptr || down->shape.size() != 3 ||
+        down->shape[0] != n_experts_ || down->shape[1] != hidden_ ||
+        down->shape[2] != dim_) {
+      throw std::runtime_error("moe needs (E, hidden, dim) down");
+    }
+    // keep double: float(0.9) = 0.89999997 would shift the ceil below
+    // by one and drop a token the Python side keeps
+    capacity_factor_ = Param("capacity_factor", 1.25);
+    residual_ = Param("residual", 1) != 0;
+    output_shape_ = input_shape;
+    return output_shape_;
+  }
+
+  void Execute(const float* input, float* output,
+               int64_t batch) const override {
+    const float* router = Array("weights")->data.data();
+    const float* up = Array("up")->data.data();
+    const float* down = Array("down")->data.data();
+    // ceil(T * cf / E), at least 1 — per SAMPLE, like the Python
+    // dense path (the engine calls Execute per sample, but a batched
+    // caller must see identical routing)
+    const int64_t capacity = std::max<int64_t>(
+        1, static_cast<int64_t>(
+               std::ceil(static_cast<double>(tokens_per_sample_) *
+                         capacity_factor_ / n_experts_)));
+    std::vector<float> logits(n_experts_);
+    std::vector<float> h(hidden_);
+    std::vector<int64_t> used(n_experts_);
+    for (int64_t bi = 0; bi < batch; ++bi) {
+      std::fill(used.begin(), used.end(), 0);
+      for (int64_t ti = 0; ti < tokens_per_sample_; ++ti) {
+      const int64_t t = bi * tokens_per_sample_ + ti;
+      const float* x = input + t * dim_;
+      float* out = output + t * dim_;
+      for (int64_t e = 0; e < n_experts_; ++e) {
+        float dot = 0.0f;
+        for (int64_t d = 0; d < dim_; ++d) {
+          dot += x[d] * router[d * n_experts_ + e];
+        }
+        logits[e] = dot;
+      }
+      Softmax(logits.data(), n_experts_);
+      int64_t expert = 0;
+      for (int64_t e = 1; e < n_experts_; ++e) {
+        if (logits[e] > logits[expert]) expert = e;
+      }
+      const float gate = logits[expert];
+      const bool kept = used[expert]++ < capacity;
+      if (!kept) {
+        std::fill(out, out + dim_, 0.0f);
+      } else {
+        const float* w_up = up + expert * dim_ * hidden_;
+        const float* w_dn = down + expert * hidden_ * dim_;
+        std::fill(h.begin(), h.end(), 0.0f);
+        for (int64_t d = 0; d < dim_; ++d) {
+          const float xv = x[d];
+          if (xv == 0.0f) continue;
+          const float* row = w_up + d * hidden_;
+          for (int64_t j = 0; j < hidden_; ++j) h[j] += xv * row[j];
+        }
+        for (int64_t j = 0; j < hidden_; ++j) {
+          h[j] = std::max(h[j], 0.0f);  // jax.nn.relu
+        }
+        std::fill(out, out + dim_, 0.0f);
+        for (int64_t j = 0; j < hidden_; ++j) {
+          const float hv = h[j] * gate;
+          if (hv == 0.0f) continue;
+          const float* row = w_dn + j * dim_;
+          for (int64_t d = 0; d < dim_; ++d) out[d] += hv * row[d];
+        }
+      }
+      if (residual_) {
+        for (int64_t d = 0; d < dim_; ++d) out[d] += x[d];
+      }
+      }
+    }
+  }
+
+ private:
+  int64_t dim_ = 0, tokens_per_sample_ = 1, n_experts_ = 0, hidden_ = 0;
+  double capacity_factor_ = 1.25;
+  bool residual_ = true;
+};
+
 class IdentityUnit : public Unit {
  public:
   const char* Name() const override { return "Identity"; }
@@ -547,6 +664,7 @@ void RegisterBuiltinUnits() {
   f.Register("ActivationUnit", Make<ActivationUnitImpl>);
   f.Register("DropoutForward", Make<IdentityUnit>);
   f.Register("MultiHeadAttentionForward", Make<AttentionUnit>);
+  f.Register("MoEForward", Make<MoEUnit>);
   // stable uuid5(namespace, class name) ids matching the Python-side
   // UnitRegistry (veles_tpu/unit_registry.py); regenerate with:
   //   python -c "import uuid; ns=uuid.UUID('6ba7b812-9dad-11d1-80b4-
@@ -578,6 +696,7 @@ void RegisterBuiltinUnits() {
                  "DropoutForward");
   f.RegisterUuid("794d6e18-a610-5449-8002-e65c30c7b62e",
                  "MultiHeadAttentionForward");
+  f.RegisterUuid("8c3ba037-c08e-529e-837b-42f4c1929bd5", "MoEForward");
 }
 
 }  // namespace veles_native

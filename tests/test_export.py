@@ -284,3 +284,48 @@ def test_stablehlo_member_present(tmp_path):
         blob = tar.extractfile("model.stablehlo").read()
     exported = jax_export.deserialize(bytearray(blob))
     assert exported is not None
+
+
+def test_native_matches_jax_moe(native_lib, tmp_path):
+    """The MoE layer exports too: the C++ runtime's Switch-style
+    top-1 FFN (router softmax, first-come capacity, strict-relu
+    hidden, gate scaling, residual) matches the JAX forward.
+
+    The MoE sits FIRST in the stack so both runtimes route identical
+    inputs: discrete top-1 routing amplifies upstream float noise
+    (an earlier attention layer's harmless ~1e-5 differences can flip
+    a near-tie argmax and change which token gets dropped), so exact
+    parity is only well-defined on shared router inputs."""
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.export.native import NativeWorkflow
+    from veles_tpu.loader.fullbatch import ProviderLoader
+    from veles_tpu.standard_workflow import StandardWorkflow
+
+    prng._generators.clear()
+    prng.get().seed(41)
+    prng.get("loader").seed(42)
+    rng = numpy.random.RandomState(9)
+
+    def provider():
+        data = rng.rand(120, 16, 16).astype(numpy.float32)
+        labels = rng.randint(0, 8, 120).astype(numpy.int32)
+        return data[:100], labels[:100], data[100:], labels[100:]
+
+    wf = StandardWorkflow(
+        DummyLauncher(),
+        loader=lambda w: ProviderLoader(w, provider=provider,
+                                        minibatch_size=40,
+                                        sequence=True,
+                                        normalization_type="none"),
+        layers=[{"type": "moe", "n_experts": 4, "hidden": 32},
+                {"type": "softmax", "output_sample_shape": 8}],
+        loss="softmax", max_epochs=1)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    path = wf.package_export(str(tmp_path / "moe_model.tar"))
+    batch = rng.rand(6, 16, 16).astype(numpy.float32)
+    expect = _jax_forward(wf, batch).reshape(6, -1)
+    with NativeWorkflow(path) as native:
+        assert native.unit_count == 2
+        got = native.run(batch)
+    numpy.testing.assert_allclose(got, expect, rtol=5e-5, atol=5e-6)

@@ -113,6 +113,17 @@ def _export_dropout(unit):
     return {"identity": True}
 
 
+@exporter("MoEForward")
+def _export_moe(unit):
+    data = _common(unit)   # router rides as "weights" (dim, E)
+    data["up"] = numpy.asarray(unit.up.map_read(), numpy.float32)
+    data["down"] = numpy.asarray(unit.down.map_read(), numpy.float32)
+    data["n_experts"] = int(unit.n_experts)
+    data["capacity_factor"] = float(unit.capacity_factor)
+    data["residual"] = int(bool(unit.residual))
+    return data
+
+
 @exporter("MultiHeadAttentionForward")
 def _export_attention(unit):
     data = _common(unit)   # weights (4, D, D) + bias (4, D)

@@ -100,18 +100,29 @@ class MoEForward(ForwardBase):
     def apply(self, params, x):
         from veles_tpu.parallel.ep import moe_ffn, moe_ffn_reference
 
-        tokens = x.reshape(-1, x.shape[-1])
         if self._ep_mesh_ is not None:
+            tokens = x.reshape(-1, x.shape[-1])
             y = moe_ffn(tokens, params["weights"], params["up"],
                         params["down"], self._ep_mesh_, self._ep_axis_,
-                        capacity_factor=self.capacity_factor)
+                        capacity_factor=self.capacity_factor
+                        ).reshape(x.shape)
         else:
-            y = moe_ffn_reference(tokens, params["weights"],
-                                  params["up"], params["down"],
-                                  self.n_experts,
-                                  capacity_factor=self.capacity_factor,
-                                  n_shards=1)
-        y = y.reshape(x.shape)
+            # dense path: capacity pools PER SAMPLE, so inference is
+            # batch-composition-independent (the same sample routes
+            # identically whatever it shares a batch with) — matching
+            # the native runtime exactly. Consequence: on 2D (n, dim)
+            # inputs every sample is a single token and capacity
+            # (>= 1) never drops anything — deliberate; capacity is a
+            # sequence-length concept. The expert-parallel path above
+            # pools per device shard instead (the Switch training
+            # contract).
+            import jax
+
+            per_sample = x.reshape(x.shape[0], -1, x.shape[-1])
+            y = jax.vmap(lambda s: moe_ffn_reference(
+                s, params["weights"], params["up"], params["down"],
+                self.n_experts, capacity_factor=self.capacity_factor,
+                n_shards=1))(per_sample).reshape(x.shape)
         if self.residual:
             y = y + x
         return y.astype(x.dtype)
