@@ -273,3 +273,41 @@ def test_r4_plotters_pub_roundtrip(server, make, check):
     assert clone is not None, "no snapshot arrived"
     assert clone._workflow is None
     assert check(clone), "clone state wrong for %s" % type(clone).__name__
+
+
+def test_client_backend_fallback(tmp_path, server):
+    """--backend selection with the reference's fallback behavior: an
+    unloadable backend warns and lands on Agg instead of dying."""
+    client = GraphicsClient(server.endpoints["tcp"], mode="png",
+                            out=str(tmp_path),
+                            backend="NoSuchBackend123")
+    import matplotlib
+    assert matplotlib.get_backend().lower() == "agg"
+    client.close()
+
+
+def test_master_slave_stats_ticker(server):
+    """A master with a live graphics server gets the SlaveStats chart
+    driven by the launcher's own timer — the master never executes
+    workflow units, so the chart cannot ride the unit graph
+    (reference plotting_units.py:822 fed it from slave callbacks)."""
+    import time
+
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.workflow import Workflow
+
+    launcher = Launcher(graphics=False)
+    launcher.workflow = Workflow(launcher)
+    launcher._graphics_server = server
+    launcher._server = _FakeCoordinator()
+    launcher._start_slave_stats(interval=0.05)
+    plotter = launcher._slave_stats_plotter
+    deadline = time.time() + 5
+    while time.time() < deadline and len(
+            plotter.history.get("s0", ())) < 2:
+        time.sleep(0.05)
+    launcher._finished.set()
+    assert set(plotter.history) == {"s0", "s1"}
+    assert len(plotter.history["s0"]) >= 2
+    # per-tick deltas, not lifetime totals
+    assert plotter.history["s1"][-1][0] == 5

@@ -19,13 +19,29 @@ from veles_tpu.logger import Logger
 class GraphicsClient(Logger):
     """SUB-socket consumer rendering plotter snapshots."""
 
-    def __init__(self, endpoint, mode="png", out=None, **kwargs):
+    def __init__(self, endpoint, mode="png", out=None, backend=None,
+                 **kwargs):
         super(GraphicsClient, self).__init__(**kwargs)
         self.endpoint = endpoint
         self.mode = mode
         self.out = out or os.getcwd()
-        if mode != "show":
-            import matplotlib
+        import matplotlib
+        if backend:
+            # reference graphics_client.py:124-147 selected the
+            # matplotlib backend (Qt/Tk/WebAgg) with fallback; same
+            # role, Agg is the headless fallback here. use() only
+            # validates the NAME — the pyplot import is what actually
+            # loads the backend module (and raises for a valid name
+            # whose GUI toolkit is missing), so it must sit INSIDE
+            # the try for the fallback to mean anything
+            try:
+                matplotlib.use(backend, force=True)
+                import matplotlib.pyplot  # noqa: F401
+            except (ImportError, ValueError) as exc:
+                self.warning("backend %r not loadable (%s); "
+                             "falling back to Agg", backend, exc)
+                matplotlib.use("Agg", force=True)
+        elif mode != "show":
             matplotlib.use("Agg")
         import zmq
         self._context_ = zmq.Context.instance()
@@ -83,8 +99,12 @@ def main(argv=None):
     parser.add_argument("--mode", default="png",
                         choices=("show", "png", "pdf"))
     parser.add_argument("--out", default=None)
+    parser.add_argument("--backend", default=None,
+                        help="matplotlib backend (e.g. TkAgg, WebAgg); "
+                             "falls back to Agg when not loadable")
     args = parser.parse_args(argv)
-    GraphicsClient(args.endpoint, mode=args.mode, out=args.out).run()
+    GraphicsClient(args.endpoint, mode=args.mode, out=args.out,
+                   backend=args.backend).run()
 
 
 if __name__ == "__main__":

@@ -357,6 +357,34 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             self._node_launcher = NodeLauncher(
                 self.nodes, command, master_address=advertise,
                 respawn=self.respawn).start()
+        self._start_slave_stats()
+
+    def _start_slave_stats(self, interval=2.0):
+        """Master-side driver for the per-slave load chart.
+
+        The master never executes workflow units (jobs run on slaves,
+        and plotters are disabled there), so the SlaveStats plotter
+        cannot ride the unit graph — it ticks on its own timer off the
+        live coordinator registry, the role the reference fed from
+        ``apply_data_from_slave`` callbacks
+        (``veles/plotting_units.py:822``). Only started when a
+        graphics server exists to publish to."""
+        if self._graphics_server is None or self._server is None:
+            return
+        from veles_tpu.plotting_units import SlaveStats
+        plotter = SlaveStats(self.workflow, name="slave stats",
+                             server=self._server)
+        self._slave_stats_plotter = plotter
+
+        def tick():
+            while not self._finished.wait(interval):
+                try:
+                    plotter.run()
+                except Exception:  # a chart must never kill the master
+                    pass
+
+        threading.Thread(target=tick, daemon=True,
+                         name="slave-stats").start()
 
     def _connect_slave(self):
         from veles_tpu.parallel.coordinator import CoordinatorClient

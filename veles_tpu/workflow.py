@@ -431,6 +431,10 @@ class Workflow(Container):
             plotter.link_attrs(evaluator, ("input", "confusion_matrix"))
             plotter.gate_skip = ~self.loader.epoch_ended
             self.plotters.append(plotter)
+        # the SlaveStats chart is NOT wired here: on a master the
+        # workflow graph never executes (jobs run on slaves), so the
+        # launcher drives it from its own ticker —
+        # Launcher._start_slave_stats
         # plotters may be wired onto an already-initialized workflow
         for plotter in self.plotters:
             if not plotter.is_initialized:
