@@ -211,3 +211,38 @@ def test_tokenless_non_loopback_bind_refused(tmp_path):
     s = ForgeServer(str(tmp_path), host="0.0.0.0", port=0, token=None,
                     allow_insecure=True)
     s._server.server_close()
+
+
+def test_update_forge_bulk_sync(forge, capsys):
+    """scripts/update_forge: scan a tree for manifest-bearing package
+    dirs and upload each — one broken package reports and does not
+    abort the sweep (reference veles/scripts/update_forge.py role)."""
+    from veles_tpu.scripts.update_forge import main
+
+    server, client, tmp_path = forge
+    scan = tmp_path / "models"
+    scan.mkdir()
+    _make_package(scan, name="model-a", version="1.0")
+    _make_package(scan, name="model-b", version="2.0")
+    broken = scan / "broken"
+    broken.mkdir()
+    (broken / "manifest.json").write_text("{not json")
+
+    # dry run uploads nothing
+    main([str(scan), "--server", "127.0.0.1:%d" % server.port,
+          "--token", "sekret", "--dry-run"])
+    assert client.list() == []
+
+    rc = main([str(scan), "--server",
+               "127.0.0.1:%d" % server.port, "--token", "sekret"])
+    out = capsys.readouterr()
+    names = {m["name"] for m in client.list()}
+    assert names == {"model-a", "model-b"}
+    assert rc != 0  # the broken package was reported as a failure
+    assert "FAILED" in out.err
+
+    # empty scan dir is an explicit error
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main([str(empty), "--server",
+                 "127.0.0.1:%d" % server.port]) == 1

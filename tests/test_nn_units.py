@@ -408,3 +408,60 @@ def test_moe_unit_expert_parallel_matches_dense():
     with pytest.raises(ValueError, match="shard"):
         MoEForward(DummyWorkflow(), n_experts=4).use_experts(
             build_mesh({"expert": 8}))
+
+
+def test_moe_aux_loss_spreads_expert_usage():
+    """Switch load-balancing: with aux_loss_weight > 0 the fused
+    trainer adds the balance term to the gradient loss, and the
+    trained router spreads tokens over more experts than the
+    unregularized run (which collapses)."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu import prng
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader.fullbatch import ProviderLoader
+    from veles_tpu.standard_workflow import StandardWorkflow
+    from veles_tpu.train import FusedTrainer
+
+    rng = numpy.random.RandomState(12)
+    protos = rng.randn(4, 16).astype("f")
+    labels_all = rng.randint(0, 4, 240).astype(numpy.int32)
+    data_all = protos[labels_all] + rng.randn(240, 16).astype("f") * 0.3
+
+    def provider():
+        # 210 train / 40 minibatch: the tail batch carries 30 padded
+        # rows, exercising the aux loss's validity masking (unmasked,
+        # uniform-softmax padding rows would all tie onto expert 0)
+        return (data_all[:210], labels_all[:210],
+                data_all[210:], labels_all[210:])
+
+    def train(aux_weight):
+        prng.get().seed(3)
+        prng.get("loader").seed(4)
+        wf = StandardWorkflow(
+            DummyLauncher(),
+            loader=lambda w: ProviderLoader(w, provider=provider,
+                                            minibatch_size=40,
+                                            normalization_type="none"),
+            layers=[{"type": "moe", "n_experts": 4, "hidden": 32,
+                     "aux_loss_weight": aux_weight},
+                    {"type": "softmax", "output_sample_shape": 4}],
+            loss="softmax", learning_rate=0.05, momentum=0.9,
+            max_epochs=10)
+        wf.initialize(device=Device(backend="cpu"))
+        history = FusedTrainer(wf).train()
+        moe = wf.forwards[0]
+        router = jnp.asarray(moe.weights.map_read())
+        assignment = numpy.asarray(
+            jnp.argmax(jnp.asarray(data_all) @ router, axis=-1))
+        counts = numpy.bincount(assignment, minlength=4)
+        return history, counts / counts.sum()
+
+    hist_plain, frac_plain = train(0.0)
+    hist_aux, frac_aux = train(0.05)
+    # both still learn the task
+    assert hist_aux[-1]["validation"]["normalized"] <= 0.2
+    # the balance term spreads routing: lower max-expert share
+    assert frac_aux.max() < frac_plain.max(), (frac_plain, frac_aux)

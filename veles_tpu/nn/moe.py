@@ -28,13 +28,17 @@ class MoEForward(ForwardBase):
     """
 
     def __init__(self, workflow, n_experts=8, hidden=None,
-                 capacity_factor=1.25, residual=True, **kwargs):
+                 capacity_factor=1.25, residual=True,
+                 aux_loss_weight=0.0, **kwargs):
         kwargs.setdefault("include_bias", False)
         super(MoEForward, self).__init__(workflow, **kwargs)
         self.n_experts = int(n_experts)
         self.hidden = hidden  # default: 4 * dim, set at initialize
         self.capacity_factor = float(capacity_factor)
         self.residual = residual
+        #: Switch load-balancing aux-loss weight, added to the FUSED
+        #: training loss (opt-in: 0.0 keeps fused == eager numerics)
+        self.aux_loss_weight = float(aux_loss_weight)
         self.up = Array()
         self.down = Array()
         self._ep_mesh_ = None
@@ -126,3 +130,22 @@ class MoEForward(ForwardBase):
         if self.residual:
             y = y + x
         return y.astype(x.dtype)
+
+    def aux_loss(self, params, x, valid=None):
+        """weight * Switch load-balance loss over this batch's router
+        probabilities — the FusedTrainer adds it to the training loss
+        when ``aux_loss_weight`` > 0. Router math identical to the
+        dispatch path, so the nudged distribution is the served one;
+        ``valid`` (per-SAMPLE mask) keeps a tail batch's zero padding
+        rows out of the balance statistics."""
+        import jax
+        import jax.numpy as jnp
+
+        from veles_tpu.parallel.ep import load_balance_loss
+        tokens = x.reshape(-1, x.shape[-1])
+        probs = jax.nn.softmax(tokens @ params["weights"], axis=-1)
+        weights = None
+        if valid is not None:
+            per_sample = tokens.shape[0] // x.shape[0]
+            weights = jnp.repeat(valid.astype(probs.dtype), per_sample)
+        return self.aux_loss_weight * load_balance_loss(probs, weights)

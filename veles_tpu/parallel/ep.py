@@ -119,3 +119,32 @@ def moe_ffn_reference(x, router_w, w_up, w_down, n_experts,
         out = out.at[s * t:(s + 1) * t].set(
             picked * (gate * keep)[:, None].astype(x.dtype))
     return out
+
+
+def load_balance_loss(probs, weights=None):
+    """Switch-style load-balancing auxiliary loss.
+
+    ``probs`` — (tokens, E) router softmax. With ``f_e`` the fraction
+    of tokens whose top-1 choice is expert e and ``P_e`` the mean
+    router probability of e, returns ``E * sum_e f_e * P_e`` —
+    minimized (=1) at uniform routing; the gradient flows through
+    ``P`` (``f`` is piecewise constant), nudging the router away from
+    collapse onto a few experts (observed here: a 1-epoch run
+    concentrating 96 tokens onto 2 of 4 experts).
+
+    ``weights`` (tokens,) optionally masks/weights tokens — the fused
+    trainer passes the padded-row validity mask so a short tail batch
+    (whose padding rows are all-zero and would all tie onto expert 0)
+    cannot distort the balance statistics.
+    """
+    n_experts = probs.shape[-1]
+    assignment = jax.nn.one_hot(jnp.argmax(probs, axis=-1), n_experts)
+    if weights is None:
+        f = jnp.mean(assignment, axis=0)
+        p = jnp.mean(probs, axis=0)
+    else:
+        w = weights.astype(probs.dtype)
+        w = w / jnp.maximum(jnp.sum(w), 1.0)
+        f = jnp.sum(assignment * w[:, None], axis=0)
+        p = jnp.sum(probs * w[:, None], axis=0)
+    return n_experts * jnp.sum(f * p)

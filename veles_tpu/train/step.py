@@ -62,9 +62,19 @@ class FusedTrainer(Logger):
 
     # -- pure functions ----------------------------------------------------
 
-    def _forward(self, params_list, x, key, train):
-        """Run the forward chain; the head uses apply_for_grad (logits)."""
+    def _forward(self, params_list, x, key, train, aux=None,
+                 valid=None):
+        """Run the forward chain; the head uses apply_for_grad (logits).
+
+        ``aux`` (train path): a list that collects units' auxiliary
+        loss terms (e.g. MoE load balancing) for the grad loss;
+        ``valid`` is the padded-row mask those terms must respect."""
         for i, fwd in enumerate(self.forwards):
+            if aux is not None:
+                aux_fn = getattr(fwd, "aux_loss", None)
+                if aux_fn is not None and \
+                        getattr(fwd, "aux_loss_weight", 0.0):
+                    aux.append(aux_fn(params_list[i], x, valid=valid))
             is_head = i == len(self.forwards) - 1
             if isinstance(fwd, DropoutForward):
                 if train:
@@ -249,9 +259,15 @@ class FusedTrainer(Logger):
             valid = idx >= 0
 
             def loss_fn(plist):
-                out = self._forward(plist, x, key, train=True)
+                aux = []
+                out = self._forward(plist, x, key, train=True, aux=aux,
+                                    valid=valid)
                 grad_loss, report, metric = self._loss_and_metrics(
                     out, truth, valid)
+                # auxiliary terms (MoE load balancing) shape gradients
+                # only; the human-facing report stays the task loss
+                for term in aux:
+                    grad_loss = grad_loss + term
                 return grad_loss, (report, metric)
 
             (_, (loss, metric)), grads = jax.value_and_grad(
