@@ -174,3 +174,20 @@ def test_sequence_workflow_with_moe_trains():
     best = min(h["validation"]["normalized"]
                for h in wf.decision.epoch_history)
     assert best <= 0.15, best
+
+
+def test_mha_ulysses_schedule_matches_local():
+    """use_ring(schedule='ulysses') swaps the same unit onto the
+    all-to-all sequence-parallel plan; numbers unchanged. Needs heads
+    divisible by the axis (8 heads / 8 shards here)."""
+    unit = _build_unit(seq=32, heads=8)
+    params = {k: jnp.asarray(v.mem) for k, v in
+              unit.param_arrays().items()}
+    x = jnp.asarray(RNG.randn(2, 32, 16).astype("f"))
+    y_local = unit.apply(params, x)
+    unit.use_ring(build_mesh({"seq": 8}), schedule="ulysses")
+    y_u = unit.apply(params, x)
+    numpy.testing.assert_allclose(numpy.asarray(y_u),
+                                  numpy.asarray(y_local), atol=3e-5)
+    with pytest.raises(ValueError, match="schedule"):
+        unit.use_ring(build_mesh({"seq": 8}), schedule="nope")

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 
 from veles_tpu.nn.base import ForwardBase
 from veles_tpu.nn.gd import GradientDescentBase
-from veles_tpu.parallel.sequence import local_attention, ring_attention
+from veles_tpu.parallel.sequence import (local_attention, ring_attention,
+                                         ulysses_attention)
 
 
 class MultiHeadAttentionForward(ForwardBase):
@@ -40,20 +41,33 @@ class MultiHeadAttentionForward(ForwardBase):
         self._seq_mesh_ = None
         self._seq_axis_ = "seq"
 
-    def use_ring(self, mesh, axis="seq"):
-        """Attach a sequence mesh: apply() switches to ring attention.
+    def use_ring(self, mesh, axis="seq", schedule="ring"):
+        """Attach a sequence mesh: apply() switches to the sharded
+        plan — ``schedule="ring"`` (ppermute streaming-softmax hops) or
+        ``"ulysses"`` (two all_to_alls, exact full-sequence attention
+        per head slice; needs heads divisible by the axis).
 
         Runtime configuration (meshes are process-local device handles,
         so this is transient state — reattach after a snapshot resume).
         """
+        if schedule not in ("ring", "ulysses"):
+            raise ValueError("unknown sp schedule %r" % (schedule,))
+        if schedule == "ulysses" and self.heads % mesh.shape[axis]:
+            # both operands are known NOW — reject at the call that
+            # causes it, not deep into the first forward trace
+            raise ValueError(
+                "ulysses needs heads (%d) divisible by the %r axis "
+                "(%d)" % (self.heads, axis, mesh.shape[axis]))
         self._seq_mesh_ = mesh
         self._seq_axis_ = axis
+        self._seq_schedule_ = schedule
         return self
 
     def init_unpickled(self):
         super(MultiHeadAttentionForward, self).init_unpickled()
         self._seq_mesh_ = None
         self._seq_axis_ = "seq"
+        self._seq_schedule_ = "ring"
 
     def _placement_mesh(self):
         # base place_for_grad/param_values/_input_devmem re-place every
@@ -93,8 +107,14 @@ class MultiHeadAttentionForward(ForwardBase):
 
         q, k, v = (split(proj(i, x)) for i in range(3))
         if self._seq_mesh_ is not None:
-            ctx = ring_attention(q, k, v, self._seq_mesh_,
-                                 self._seq_axis_, causal=self.causal)
+            if self._seq_schedule_ == "ulysses":
+                ctx = ulysses_attention(q, k, v, self._seq_mesh_,
+                                        self._seq_axis_,
+                                        causal=self.causal)
+            else:
+                ctx = ring_attention(q, k, v, self._seq_mesh_,
+                                     self._seq_axis_,
+                                     causal=self.causal)
         else:
             ctx = local_attention(q, k, v, causal=self.causal)
         merged = ctx.transpose(0, 2, 1, 3).reshape(batch, seq, dim)
