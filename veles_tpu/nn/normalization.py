@@ -28,6 +28,9 @@ def _lrn_slices(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
     window = sum(
         jax.lax.slice_in_dim(padded, i, i + channels, axis=x.ndim - 1)
         for i in range(n))
+    # plain pow: a beta=0.75 rsqrt(s)*sqrt(rsqrt(s)) specialization was
+    # measured r4 at 12.69 vs 12.35 ms/step — the transcendental is NOT
+    # the LRN cost (docs/PERF.md: the floor is structural traffic)
     return x / jnp.power(k + alpha * window, beta)
 
 
