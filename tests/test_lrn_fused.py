@@ -82,3 +82,23 @@ def test_dispatch_stays_on_slices_off_tpu():
     Pallas kernels would need interpret mode there)."""
     x = jnp.asarray(RNG.randn(2, 3, 3, 16).astype("f"))
     numpy.testing.assert_allclose(lrn(x), _lrn_slices(x), atol=0)
+
+
+def test_lrn_cumsum_formulation_matches_slices():
+    """The env-gated cumsum-window variant (a measured TPU negative
+    result kept re-runnable, like the Pallas one) is float-equivalent
+    to the default slices form, gradients included."""
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.nn.normalization import _lrn_cumsum, _lrn_slices
+
+    x = jnp.asarray(numpy.random.RandomState(0).randn(
+        2, 5, 5, 96).astype("f"))
+    numpy.testing.assert_allclose(
+        numpy.asarray(_lrn_slices(x)), numpy.asarray(_lrn_cumsum(x)),
+        atol=1e-6)
+    ga = jax.grad(lambda t: jnp.sum(_lrn_slices(t) ** 2))(x)
+    gb = jax.grad(lambda t: jnp.sum(_lrn_cumsum(t) ** 2))(x)
+    numpy.testing.assert_allclose(numpy.asarray(ga), numpy.asarray(gb),
+                                  atol=1e-5)

@@ -50,7 +50,35 @@ def lrn(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
     if x.ndim == 4 and n % 2 == 1 and force == "pallas":
         from veles_tpu.ops.lrn import lrn_fused
         return lrn_fused(x, k, alpha, beta, n, interpret=not on_tpu)
+    if force == "cumsum":
+        return _lrn_cumsum(x, k, alpha, beta, n)
     return _lrn_slices(x, k, alpha, beta, n)
+
+
+def _lrn_cumsum(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
+    """Prefix-sum formulation: window = cs[c+half] - cs[c-half-1] — one
+    channel cumsum + a subtract instead of n shifted adds (backward is
+    a reverse cumsum). Float rounding differs from the slices form by
+    association only (1e-7 measured).
+
+    Kept as the THIRD measured negative result for the LRN floor
+    (``VELES_LRN=cumsum`` to re-run): 16.43 vs 12.35 ms/step on the
+    staged AlexNet — a cumsum over the minor (lane) axis is a
+    sequential scan on TPU, far worse than n fusable shifted adds.
+    With Pallas fusion (−22%) and the pow specialization (flat) also
+    ruled out, the slices form stands as measured-best (docs/PERF.md).
+    """
+    sq = jnp.square(x)
+    cs = jnp.cumsum(sq, axis=-1)
+    half = n // 2
+    channels = x.shape[-1]
+    upper = jnp.concatenate(
+        [cs[..., half:],
+         jnp.broadcast_to(cs[..., -1:], cs.shape[:-1] + (half,))], -1)
+    lower = jnp.concatenate(
+        [jnp.zeros_like(cs[..., :half + 1]),
+         cs[..., :channels - half - 1]], -1)
+    return x / jnp.power(k + alpha * (upper - lower), beta)
 
 
 class LRNormalizerForward(ForwardBase):
