@@ -88,10 +88,7 @@ def test_lrn_cumsum_formulation_matches_slices():
     """The env-gated cumsum-window variant (a measured TPU negative
     result kept re-runnable, like the Pallas one) is float-equivalent
     to the default slices form, gradients included."""
-    import jax
-    import jax.numpy as jnp
-
-    from veles_tpu.nn.normalization import _lrn_cumsum, _lrn_slices
+    from veles_tpu.nn.normalization import _lrn_cumsum
 
     x = jnp.asarray(numpy.random.RandomState(0).randn(
         2, 5, 5, 96).astype("f"))
@@ -102,3 +99,17 @@ def test_lrn_cumsum_formulation_matches_slices():
     gb = jax.grad(lambda t: jnp.sum(_lrn_cumsum(t) ** 2))(x)
     numpy.testing.assert_allclose(numpy.asarray(ga), numpy.asarray(gb),
                                   atol=1e-5)
+    # dispatcher: even n (asymmetric window) and tiny channel counts
+    # fall back to slices semantics instead of silently diverging
+    import os
+    from veles_tpu.nn.normalization import lrn
+    os.environ["VELES_LRN"] = "cumsum"
+    try:
+        for shape, n in (((1, 3, 3, 8), 4), ((1, 3, 3, 2), 5)):
+            y = jnp.asarray(numpy.random.RandomState(1).randn(
+                *shape).astype("f"))
+            numpy.testing.assert_allclose(
+                numpy.asarray(lrn(y, n=n)),
+                numpy.asarray(_lrn_slices(y, n=n)), atol=1e-6)
+    finally:
+        os.environ.pop("VELES_LRN")

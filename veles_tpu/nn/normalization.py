@@ -50,7 +50,10 @@ def lrn(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
     if x.ndim == 4 and n % 2 == 1 and force == "pallas":
         from veles_tpu.ops.lrn import lrn_fused
         return lrn_fused(x, k, alpha, beta, n, interpret=not on_tpu)
-    if force == "cumsum":
+    if force == "cumsum" and n % 2 == 1 and x.shape[-1] > n // 2:
+        # same odd-n guard as the Pallas branch (even n is an
+        # asymmetric window the symmetric cumsum form cannot express);
+        # tiny channel counts fall back too
         return _lrn_cumsum(x, k, alpha, beta, n)
     return _lrn_slices(x, k, alpha, beta, n)
 
@@ -72,6 +75,11 @@ def _lrn_cumsum(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
     cs = jnp.cumsum(sq, axis=-1)
     half = n // 2
     channels = x.shape[-1]
+    if channels <= half:
+        raise ValueError(
+            "cumsum LRN needs channels (%d) > n//2 (%d) — the "
+            "dispatcher falls back to slices below that" %
+            (channels, half))
     upper = jnp.concatenate(
         [cs[..., half:],
          jnp.broadcast_to(cs[..., -1:], cs.shape[:-1] + (half,))], -1)
