@@ -81,6 +81,15 @@ class AvatarServer(object):
     def __init__(self, avatar, host="127.0.0.1", port=0):
         import socket
 
+        if host not in ("127.0.0.1", "localhost", "::1"):
+            # Avatar frames carry no auth (unlike the coordinator's
+            # nonce+HMAC handshake) — anyone who can reach the port can
+            # pull the model. Loopback is the supported deployment.
+            import logging
+            logging.getLogger("AvatarServer").warning(
+                "binding to non-loopback %s: avatar pulls are "
+                "UNAUTHENTICATED; tunnel over SSH or keep on loopback",
+                host)
         self.avatar = avatar
         self._lock = threading.Lock()
         self._encoded = {}

@@ -377,11 +377,17 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self._slave_stats_plotter = plotter
 
         def tick():
+            warned = False
             while not self._finished.wait(interval):
                 try:
                     plotter.run()
+                    warned = False  # re-arm: log each NEW failure streak
                 except Exception:  # a chart must never kill the master
-                    pass
+                    if not warned:
+                        warned = True
+                        self.warning("SlaveStats plotter failing; chart "
+                                     "stale until it recovers",
+                                     exc_info=True)
 
         threading.Thread(target=tick, daemon=True,
                          name="slave-stats").start()

@@ -72,6 +72,14 @@ class SGD(Solver):
             # would break the lax.scan carry pytree (such snapshots
             # predate lr_decay, so the schedule loses nothing)
             new_state["step"] = step + 1.0
+        elif hp.get("lr_decay", 1.0) != 1.0:
+            # runs at trace time (static dict structure), so once per
+            # compile, not per step
+            import logging
+            logging.getLogger("SGD").warning(
+                "lr_decay=%s configured but the restored solver state "
+                "has no step counter (pre-r4 snapshot): the decay "
+                "scale is pinned to 1.0", hp["lr_decay"])
         return new_p, new_state
 
 

@@ -123,7 +123,7 @@ def ulysses_attention(q, k, v, mesh, axis="seq", causal=False,
     all_to_alls of the activations vs the ring's n_shards ppermute
     hops — the better trade when heads divide evenly and the ICI
     bisection is wide; ring wins when H < n_shards or memory for the
-    full-sequence scores is tight. Requires H %% n_shards == 0.
+    full-sequence scores is tight. Requires H % n_shards == 0.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)

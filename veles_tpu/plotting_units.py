@@ -356,7 +356,9 @@ class SlaveStats(Plotter):
         self.period = kwargs.get("period", 100)
         self.server = kwargs.get("server")
         self._last_jobs = {}
-        self.history = {}  # sid -> list of (jobs_since_last, staleness)
+        # sid -> list of (jobs_since_last, staleness_s, n_in_flight);
+        # redraw() stacks one subplot per element
+        self.history = {}
         self.labels = {}   # sid -> "sid (pid)"
 
     def fill(self):
@@ -395,16 +397,22 @@ class SlaveStats(Plotter):
     def redraw(self, figure):
         if not self.history:
             return
-        axes = figure.add_subplot(111)
-        for sid in sorted(self.history):
-            series = self.history[sid][-self.period:]
-            axes.plot([p[0] for p in series],
-                      label=self.labels.get(sid, sid))
-        axes.set_xlabel("fill ticks")
-        axes.set_ylabel("jobs completed per tick")
-        axes.set_ylim(bottom=0)
-        axes.grid(True)
-        axes.legend(loc="best")
+        panes = (("jobs completed per tick", 0),
+                 ("staleness (s)", 1),
+                 ("jobs in flight", 2))
+        for row, (ylabel, elem) in enumerate(panes, start=1):
+            axes = figure.add_subplot(len(panes), 1, row)
+            for sid in sorted(self.history):
+                series = self.history[sid][-self.period:]
+                axes.plot([p[elem] for p in series],
+                          label=self.labels.get(sid, sid))
+            axes.set_ylabel(ylabel)
+            axes.set_ylim(bottom=0)
+            axes.grid(True)
+            if row == 1:
+                axes.legend(loc="best")
+            if row == len(panes):
+                axes.set_xlabel("fill ticks")
         figure.suptitle(self.name)
 
     def __getstate__(self):
