@@ -78,10 +78,8 @@ class FusedTrainer(Logger):
             is_head = i == len(self.forwards) - 1
             if isinstance(fwd, DropoutForward):
                 if train:
-                    keep = 1.0 - fwd.dropout_ratio
-                    sub = jax.random.fold_in(key, i)
-                    mask = (jax.random.uniform(sub, x.shape) < keep)
-                    x = x * mask.astype(x.dtype) / keep
+                    x = fwd.apply_with_key(params_list[i], x,
+                                           jax.random.fold_in(key, i))
             elif i == 0 and self._staged_s2d:
                 # dataset was packed to patch-channel layout at
                 # staging (stored with trailing dims flattened — see
