@@ -34,6 +34,8 @@ def main():
     parser.add_argument("--fc-epochs", type=int, default=40)
     parser.add_argument("--conv-epochs", type=int, default=25)
     parser.add_argument("--cifar-epochs", type=int, default=40)
+    parser.add_argument("--ae-epochs", type=int, default=30)
+    parser.add_argument("--som-epochs", type=int, default=10)
     args = parser.parse_args()
 
     if args.mnist_dir:
@@ -41,15 +43,26 @@ def main():
         provider = mnist_idx_provider(args.mnist_dir)
         dataset = "real MNIST (%s)" % args.mnist_dir
         fc_target, conv_target = 0.0160, 0.0090
+        # real MNIST: the AE bar IS the reference's published number;
+        # no published Kohonen bar exists, so the SOM targets stay the
+        # golden-digit-calibrated ones (same normalization + shapes)
+        # and are advisory there
+        ae_target = 0.5478
+        som_qe_target, som_te_target = 9.0, 0.06
     else:
         from veles_tpu.datasets import golden_digits
         provider = golden_digits(n_train=12000, n_valid=2000)
         dataset = "golden digits (committed, seed 2026, 12k/2k)"
         fc_target, conv_target = 0.0150, 0.0200
+        # AE: full-budget 0.1617 measured r5 (reference context 0.5478
+        # on real MNIST; mean-predictor floor 0.3358). SOM: QE 7.86 /
+        # TE 3.4% measured (untrained codebook: 24.5 / 96%).
+        ae_target = 0.2000
+        som_qe_target, som_te_target = 9.0, 0.06
 
-    from veles_tpu.models.parity import train_conv, train_fc
+    from veles_tpu.models.parity import (train_ae, train_cifar,
+                                         train_conv, train_fc, train_som)
     from veles_tpu.datasets import golden_objects
-    from veles_tpu.models.parity import train_cifar
     cifar_provider = golden_objects(n_train=10000, n_valid=2000)
     cifar_target = 0.1600  # beat the reference's 17.21% CIFAR-10 bar
 
@@ -62,15 +75,32 @@ def main():
     t = time.time()
     cifar_err = train_cifar(cifar_provider, args.cifar_epochs)
     t_cifar = time.time() - t
+    t = time.time()
+    ae_rmse = train_ae(provider, args.ae_epochs)
+    t_ae = time.time() - t
+    t = time.time()
+    som = train_som(provider, args.som_epochs)
+    t_som = time.time() - t
 
     rows = [
         ("FC 784-100-10 (BASELINE config 1)", fc_err, fc_target,
-         "reference 1.48% on real MNIST", t_fc),
+         "%", "reference 1.48% on real MNIST", t_fc),
         ("conv 16c5-p2-32c5-p2-100-10 (config 2 analog)", conv_err,
-         conv_target, "reference conv snapshot 0.73%", t_conv),
+         conv_target, "%", "reference conv snapshot 0.73%", t_conv),
         ("CIFAR conv cifar10-quick + mean_disp (config 2, golden "
-         "objects 32x32x3)", cifar_err, cifar_target,
+         "objects 32x32x3)", cifar_err, cifar_target, "%",
          "reference CIFAR-10 17.21%", t_cifar),
+        ("AE 784-100-784 val RMSE (BASELINE config 4)", ae_rmse,
+         ae_target, "rmse", "reference 0.5478 RMSE on real MNIST",
+         t_ae),
+        ("Kohonen 8x8 quantization error (config 4)",
+         som["quantization_error"], som_qe_target, "raw",
+         "untrained codebook %.1f" %
+         som["untrained_quantization_error"], t_som),
+        ("Kohonen 8x8 topographic error (config 4)",
+         som["topographic_error"], som_te_target, "%",
+         "untrained codebook %.0f%%" %
+         (100 * som["untrained_topographic_error"]), 0.0),
     ]
     lines = [
         "# Accuracy parity runs",
@@ -81,11 +111,15 @@ def main():
         "|---|---|---|---|---|",
     ]
     ok = True
-    for name, err, target, ctx, secs in rows:
+    for name, err, target, unit, ctx, secs in rows:
         status = "✅" if err <= target else "❌"
         ok &= err <= target
-        lines.append("| %s | **%.2f%%** %s | ≤%.2f%% | %s | %.0f |" %
-                     (name, 100 * err, status, 100 * target, ctx, secs))
+        if unit == "%":
+            val = "**%.2f%%** %s | ≤%.2f%%" % (100 * err, status,
+                                               100 * target)
+        else:
+            val = "**%.4f** %s | ≤%.4f" % (err, status, target)
+        lines.append("| %s | %s | %s | %.0f |" % (name, val, ctx, secs))
     lines += [
         "",
         "Conv beats FC: %s (%.2f%% < %.2f%%)" %

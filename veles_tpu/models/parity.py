@@ -67,6 +67,68 @@ def train_conv(provider, max_epochs, learning_rate=0.03, layers=None,
     return best_val(FusedTrainer(wf).train())
 
 
+def train_ae(provider, max_epochs, bottleneck=100, learning_rate=0.001,
+             momentum=0.9, minibatch_size=100, backend=None):
+    """MNIST autoencoder (BASELINE config 4's AE half); returns best
+    validation RMSE — the metric whose reference value is 0.5478 on
+    real MNIST (``manualrst_veles_algorithms.rst:69``). Here RMSE =
+    sqrt(mean-over-samples of per-sample feature-mean squared error)
+    on linearly normalized inputs (nn/evaluator.py:_mse_eval).
+
+    Recipe swept r5 on golden digits (12k/2k, 30 epochs): lr 0.001 +
+    momentum 0.9 → 0.1617; lr 0.003 no momentum → 0.2134; lr ≥ 0.01
+    diverges to NaN by epoch 2 (the 784-wide MSE head's gradients are
+    ~30x a softmax head's). Mean-predictor floor: 0.3358."""
+    from veles_tpu.models.mnist_ae import MnistAEWorkflow
+    prng.get().seed(1234)
+    prng.get("loader").seed(1235)
+    wf = MnistAEWorkflow(DummyLauncher(), provider=provider,
+                         bottleneck=bottleneck,
+                         minibatch_size=minibatch_size,
+                         learning_rate=learning_rate,
+                         momentum=momentum,
+                         max_epochs=max_epochs)
+    wf.initialize(device=Device(backend=backend))
+    history = FusedTrainer(wf).train()
+    # fused stats carry normalized = mean per-sample MSE; the eager
+    # Decision path's metric_rmse is sqrt of the same quantity
+    import math
+    return math.sqrt(best_val(history))
+
+
+def train_som(provider, epochs, sx=8, sy=8, minibatch_size=100,
+              backend=None):
+    """Kohonen SOM (BASELINE config 4's map half); returns the quality
+    dict from :func:`veles_tpu.nn.kohonen.som_quality` measured on the
+    TRAIN samples after ``epochs`` sweeps, plus the same metrics for
+    the untrained random codebook (the teeth baseline)."""
+    from veles_tpu.models.mnist import MnistLoader
+    from veles_tpu.models.mnist_ae import KohonenWorkflow
+    from veles_tpu.nn.kohonen import som_quality
+    prng.get().seed(1234)
+    prng.get("loader").seed(1235)
+    wf = KohonenWorkflow(
+        DummyLauncher(),
+        loader_factory=lambda w: MnistLoader(
+            w, provider=provider, minibatch_size=minibatch_size),
+        sx=sx, sy=sy, epochs=epochs)
+    wf.initialize(device=Device(backend=backend))
+    import numpy
+    # TRAIN class only: ProviderLoader lays data out [valid, train]
+    data = numpy.asarray(
+        wf.loader.original_data.mem)[wf.loader.class_lengths[1]:]
+    untrained = som_quality(
+        numpy.asarray(wf.trainer.weights.map_read()), sx, sy, data)
+    wf.run()
+    trained = som_quality(
+        numpy.asarray(wf.trainer.weights.map_read()), sx, sy, data)
+    trained["untrained_quantization_error"] = \
+        untrained["quantization_error"]
+    trained["untrained_topographic_error"] = \
+        untrained["topographic_error"]
+    return trained
+
+
 def train_cifar(provider, max_epochs, learning_rate=0.01, backend=None):
     """CIFAR-shaped conv stack (BASELINE config 2: cifar10-quick
     topology + mean_disp normalization in the loader path) on the

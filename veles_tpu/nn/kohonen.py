@@ -19,6 +19,52 @@ from veles_tpu.accelerated_units import AcceleratedUnit
 from veles_tpu.memory import Array
 
 
+def _make_grid(sx, sy):
+    """(sx*sy, 2) float32 unit-grid coordinates — the ONE layout shared
+    by the trainer's neighborhood and som_quality's adjacency (a
+    divergence here would silently break the topographic error)."""
+    gx, gy = numpy.meshgrid(numpy.arange(sx), numpy.arange(sy))
+    return numpy.stack([gx.ravel(), gy.ravel()],
+                       axis=1).astype(numpy.float32)
+
+
+@jax.jit
+def _som_quality(codebook, grid, x):
+    dots = jnp.dot(x, codebook.T, preferred_element_type=jnp.float32)
+    c2 = jnp.sum(jnp.square(codebook), axis=1)
+    x2 = jnp.sum(jnp.square(x), axis=1)
+    d2 = jnp.maximum(x2[:, None] + c2[None, :] - 2.0 * dots, 0.0)
+    _, best2 = jax.lax.top_k(-d2, 2)              # (batch, 2) BMU pair
+    qe = jnp.mean(jnp.sqrt(jnp.take_along_axis(
+        d2, best2[:, :1], axis=1)))
+    p1 = jnp.take(grid, best2[:, 0], axis=0)
+    p2 = jnp.take(grid, best2[:, 1], axis=0)
+    cheb = jnp.max(jnp.abs(p1 - p2), axis=1)
+    te = jnp.mean((cheb > 1.0).astype(jnp.float32))
+    return qe, te
+
+
+def som_quality(weights, sx, sy, data):
+    """Standard SOM quality metrics (docs/PARITY_RUNS.md config 4 bar).
+
+    * quantization error — mean Euclidean distance from each sample to
+      its best-matching unit's codebook vector;
+    * topographic error — fraction of samples whose first and second
+      BMUs are NOT 8-neighbourhood-adjacent on the sx × sy grid (map
+      topology preservation).
+
+    The reference published no Kohonen quality number
+    (``manualrst_veles_algorithms.rst`` Kohonen section lists status
+    only), so these two classic metrics define the tracked bar.
+    """
+    grid = jnp.asarray(_make_grid(sx, sy))
+    x = jnp.asarray(numpy.asarray(data, numpy.float32).reshape(
+        len(data), -1))
+    qe, te = _som_quality(jnp.asarray(weights), grid, x)
+    return {"quantization_error": float(qe),
+            "topographic_error": float(te)}
+
+
 @functools.partial(jax.jit, static_argnames=())
 def _winners(codebook, x):
     # pairwise squared distances: |c|^2 - 2 x.c  (|x|^2 constant per row)
@@ -98,10 +144,7 @@ class KohonenTrainer(AcceleratedUnit):
             w = numpy.zeros((self.neurons_number, features), numpy.float32)
             prng.get(self.rand_name).fill(w, -0.1, 0.1)
             self.weights.reset(w)
-        gx, gy = numpy.meshgrid(numpy.arange(self.sx),
-                                numpy.arange(self.sy))
-        self._grid = numpy.stack(
-            [gx.ravel(), gy.ravel()], axis=1).astype(numpy.float32)
+        self._grid = _make_grid(self.sx, self.sy)
         self.winners.reset(numpy.zeros(mem.shape[0], numpy.int32))
         self.init_vectors(self.weights, self.winners)
 
