@@ -50,6 +50,10 @@ class Main(Logger):
                                  "applied to prng keys default,loader,...")
         parser.add_argument("-w", "--snapshot", default=None,
                             help="resume from a snapshot file")
+        parser.add_argument("-i", "--interactive", action="store_true",
+                            help="initialize the workflow, then drop "
+                                 "into a console with it in scope; "
+                                 "call main() there (or exit) to run")
         parser.add_argument("-v", "--verbosity", default="info",
                             choices=["debug", "info", "warning", "error"],
                             help="logging level")
@@ -161,6 +165,7 @@ class Main(Logger):
             "pipeline": getattr(args, "pipeline", True),
             "secret_file": getattr(args, "secret_file", None),
             "max_frame_mb": getattr(args, "max_frame_mb", None),
+            "interactive": getattr(args, "interactive", False),
         }
         if args.listen_address:
             kwargs["listen_address"] = args.listen_address
@@ -193,6 +198,24 @@ class Main(Logger):
                       self.args.workflow_graph)
         if self.args.dry_run == "init":
             return
+        if getattr(self.args, "interactive", False):
+            self._interact()
+            if self._run_error is not None:
+                # the console swallowed (printed) the training failure;
+                # the process exit code must still reflect it
+                raise self._run_error
+        if not self._ran:
+            self._run_and_report()
+
+    def _run_and_report(self):
+        self._ran = True  # even on failure: exiting must NOT retrain
+        try:
+            self._run_and_report_inner()
+        except BaseException as e:
+            self._run_error = e
+            raise
+
+    def _run_and_report_inner(self):
         self.launcher.run()
         self._write_results()
         # exit reports, as the reference printed at shutdown: slowest
@@ -204,6 +227,43 @@ class Main(Logger):
         self.info("device memory: %.1f MB in use, %.1f MB peak, "
                   "%d arrays", mem["bytes_in_use"] / 1e6,
                   mem["peak_bytes"] / 1e6, mem["arrays"])
+
+    def _interact(self):
+        """-i: console between initialize and run (the TPU-era analog
+        of the reference running the whole stack under an IPython
+        shell with the reactor in a thread,
+        ``veles/launcher.py:119,433-459``; here the scheduler is not
+        reactor-driven, so the console simply OWNS the step: call
+        ``main()`` inside to train now, or exit and the run resumes).
+        """
+        ns = {
+            "workflow": self.workflow,
+            "launcher": self.launcher,
+            "units": list(self.workflow.units),
+            "root": root,
+            "main": self._run_and_report,
+        }
+        banner = ("\nveles_tpu interactive mode — workflow initialized,"
+                  " not yet run.\n"
+                  "In scope: workflow, launcher, units, root, main().\n"
+                  "main() trains now; exiting the console trains if "
+                  "you haven't.")
+        use_ipython = sys.stdin.isatty()
+        if use_ipython:
+            try:
+                from IPython.terminal.embed import InteractiveShellEmbed
+            except ImportError:
+                use_ipython = False
+        try:
+            if use_ipython:
+                InteractiveShellEmbed(banner1=banner)(local_ns=ns)
+            else:
+                # piped stdin (tests, batch use): the stdlib console
+                # reads scripted lines and EOFs out cleanly
+                import code
+                code.interact(banner=banner, local=ns, exitmsg="")
+        except SystemExit:
+            pass
 
     def _write_results(self):
         if not self.args.result_file:
@@ -261,6 +321,8 @@ class Main(Logger):
     def run(self, argv=None):
         parser = self.init_parser()
         self.args = parser.parse_args(argv)
+        self._ran = False
+        self._run_error = None
         if self.args.version:
             from veles_tpu import __version__
             print(__version__)

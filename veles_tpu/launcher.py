@@ -66,6 +66,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         "job_timeout", "heartbeat_timeout", "max_idle",
         "nodes", "respawn", "slave_command", "eager", "segment_size",
         "pipeline", "secret", "secret_file", "max_frame_mb",
+        "interactive",
     ])
 
     def __init__(self, **kwargs):
@@ -92,6 +93,10 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         self.nodes = kwargs.get("nodes")
         self.respawn = kwargs.get("respawn", False)
         self.eager = kwargs.get("eager", False)
+        #: -i: the run is driven from a console (reference
+        #: ``launcher.py:119`` ran the stack under IPython); Shell
+        #: units check this to avoid embedding a console in a console
+        self.interactive = kwargs.get("interactive", False)
         #: minibatches per distributed job (1 = reference-style);
         #: segments amortize the round-trip + weight exchange
         self.segment_size = kwargs.get("segment_size", 8)
@@ -216,7 +221,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
 
     @property
     def is_interactive(self):
-        return False
+        return self.interactive
 
     # -- workflow ownership (Unit.workflow protocol) -----------------------
 
