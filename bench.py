@@ -38,22 +38,55 @@ def model_train_flops_per_sample(wf):
     FLOPs (forward + grad-input + grad-weights passes), the standard
     accounting (e.g. the scaling-book convention). Elementwise ops
     (LRN, pooling, dropout, activations) are excluded — they are
-    bandwidth, not FLOPs."""
+    bandwidth, not FLOPs. Shared with scripts/bench_all.py (ONE
+    source of truth for the published MFU tables)."""
     total = 0.0
     for fwd in wf.forwards:
         name = type(fwd).__name__
-        in_shape = tuple(fwd.input.shape)
         out_shape = tuple(fwd.output.shape)
         if name.startswith("Conv"):
             ky, kx, cin, cout = fwd.weights.shape
             out_hw = out_shape[1] * out_shape[2]
             total += 2.0 * out_hw * ky * kx * cin * cout * 3.0
+        elif name.startswith("MultiHeadAttention"):
+            _, s, d = tuple(fwd.input.shape)
+            # 4 projections (q,k,v,out) + scores + scores@v
+            total += (4 * 2.0 * s * d * d + 2 * 2.0 * s * s * d) * 3.0
+        elif name.startswith("MoE"):
+            _, s, d = tuple(fwd.input.shape)
+            # top-1 switch: each token visits ONE expert's up+down,
+            # plus the router
+            total += s * (2.0 * d * fwd.hidden * 2 +
+                          2.0 * d * fwd.n_experts) * 3.0
         elif name.startswith("All2All"):
             fin, fout = fwd.weights.shape
             total += 2.0 * fin * fout * 3.0
         # pooling/LRN/dropout: no matmul FLOPs
-        del in_shape
     return total
+
+
+def timed_segment_window(trainer, params, states, idx, keys,
+                         min_window_s):
+    """The phase-2 window discipline, shared with
+    scripts/bench_all.py: chunks of compiled segments with ONE forcing
+    read per chunk (float() pulls a scalar through the relay;
+    block_until_ready alone can return early). ~20 segments in flight
+    both amortize the round-trips and stay under the relay's
+    async-queue limit (deeper queues are rejected with
+    INVALID_ARGUMENT). Returns (params, states, segments, elapsed_s,
+    final_loss)."""
+    chunk = min(20, max(1, 2560 // idx.shape[0]))
+    segs = 0
+    start = time.time()
+    while True:
+        for _ in range(chunk):
+            params, states, losses, _ = trainer._train_segment(
+                params, states, idx, keys)
+        final_loss = float(losses[-1])
+        segs += chunk
+        elapsed = time.time() - start
+        if elapsed >= min_window_s:
+            return params, states, segs, elapsed, final_loss
 
 
 def measured_matmul_peak_tflops():
@@ -164,23 +197,10 @@ def main():
               file=sys.stderr)
 
     # -- phase 2 (timed): steady-state throughput, continuing the same
-    # training run. One forcing read per chunk (float() pulls a scalar
-    # through the relay; block_until_ready alone can return early);
-    # ~20 segments in flight both amortize the round-trips and stay
-    # under the relay's async-queue limit (deeper queues are rejected
-    # with INVALID_ARGUMENT).
-    chunk = min(20, max(1, 2560 // idx.shape[0]))
-    epochs = 0
-    start = time.time()
-    while True:
-        for _ in range(chunk):
-            params, states, losses, _ = trainer._train_segment(
-                params, states, idx, keys)
-        final_loss = float(losses[-1])
-        epochs += chunk
-        elapsed = time.time() - start
-        if elapsed >= MIN_TIMED_WINDOW_S:
-            break
+    # training run (discipline in timed_segment_window, shared with
+    # scripts/bench_all.py)
+    params, states, epochs, elapsed, final_loss = timed_segment_window(
+        trainer, params, states, idx, keys, MIN_TIMED_WINDOW_S)
     print("timed window: %d epochs x %d samples in %.1fs, loss %.3f -> "
           "%.4f" % (epochs, n_train, elapsed, series[-1], final_loss),
           file=sys.stderr)

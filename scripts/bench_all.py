@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""Measured perf for every BASELINE config + the beyond-parity units
+(VERDICT r4 next #2 — the reference imposed the same discipline on
+itself via DeviceBenchmark, ``veles/accelerated_units.py:706-824``).
+
+One row per compute path: steady-state training samples/s on the chip
+with bench.py's read-free timed-window discipline (warm segments pay
+the compile, then chunked compiled segments with ONE forcing read per
+chunk), plus analytic model TFLOP/s against the chip's measured
+large-matmul peak (MFU). bench.py stays the driver's AlexNet contract;
+this script is the breadth table committed in docs/PERF.md.
+
+MFU is matmul-FLOPs-only (the scaling-book convention bench.py uses):
+configs dominated by tiny matmuls (FC-100, SOM 8x8) honestly report
+single-digit MFU — they are latency/bandwidth bound, which is the
+point of publishing them.
+
+Usage: python scripts/bench_all.py [config ...]  (default: all)
+Prints one markdown row per config on stdout, diagnostics on stderr.
+"""
+
+import json
+import logging
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+logging.disable(logging.WARNING)
+
+MIN_WINDOW_S = float(os.environ.get("VELES_BENCH_ALL_WINDOW", 10.0))
+PRECISION = os.environ.get("VELES_BENCH_PRECISION", "bfloat16")
+
+
+def _seed():
+    from veles_tpu import prng
+    prng.get().seed(1234)
+    prng.get("loader").seed(1235)
+
+
+def _bench_fused(wf):
+    """Steady samples/s with bench.py's shared phase-2 discipline
+    (2 warm segments pay compile + settle, then the timed window)."""
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+
+    from veles_tpu.train import FusedTrainer
+    trainer = FusedTrainer(wf)
+    idx = jnp.asarray(trainer._segment_indices(2))
+    keys = jax.random.split(jax.random.PRNGKey(0), idx.shape[0])
+    params, states = trainer.pull_params()
+    for _ in range(2):
+        params, states, losses, _ = trainer._train_segment(
+            params, states, idx, keys)
+        float(losses[-1])
+    params, states, segs, elapsed, _ = bench.timed_segment_window(
+        trainer, params, states, idx, keys, MIN_WINDOW_S)
+    mb = trainer.workflow.loader.max_minibatch_size
+    valid = (idx >= 0).sum() / idx.shape[0] / mb  # fill fraction
+    return segs * idx.shape[0] * mb * float(valid) / elapsed
+
+
+# -- config builders -------------------------------------------------------
+
+
+def build_fc():
+    from veles_tpu.datasets import golden_digits
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.mnist import MnistWorkflow
+    _seed()
+    return MnistWorkflow(DummyLauncher(),
+                         provider=golden_digits(n_train=12000,
+                                                n_valid=2000),
+                         layers=(100,), minibatch_size=500,
+                         max_epochs=1)
+
+
+def build_conv():
+    from veles_tpu.datasets import golden_digits
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.mnist import MnistLoader
+    from veles_tpu.models.parity import CONV_LAYERS
+    from veles_tpu.standard_workflow import StandardWorkflow
+    _seed()
+    return StandardWorkflow(
+        DummyLauncher(),
+        loader=lambda w: MnistLoader(
+            w, provider=golden_digits(n_train=12000, n_valid=2000),
+            flatten=False, minibatch_size=250),
+        layers=CONV_LAYERS, loss="softmax", max_epochs=1)
+
+
+def build_cifar():
+    from veles_tpu.datasets import golden_objects
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.cifar import CifarWorkflow
+    _seed()
+    return CifarWorkflow(DummyLauncher(),
+                         provider=golden_objects(n_train=10000,
+                                                 n_valid=2000),
+                         minibatch_size=250, max_epochs=1)
+
+
+def build_ae():
+    from veles_tpu.datasets import golden_digits
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.mnist_ae import MnistAEWorkflow
+    _seed()
+    return MnistAEWorkflow(DummyLauncher(),
+                           provider=golden_digits(n_train=12000,
+                                                  n_valid=2000),
+                           bottleneck=100, minibatch_size=500,
+                           learning_rate=0.001, max_epochs=1)
+
+
+def build_attention():
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.samples import (SequenceProvider,
+                                          SequenceWorkflow)
+    _seed()
+    return SequenceWorkflow(
+        DummyLauncher(),
+        provider=SequenceProvider(n_train=4096, n_valid=256,
+                                  seq=256, dim=256),
+        minibatch_size=64, heads=8, max_epochs=1)
+
+
+def build_moe():
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.samples import (SequenceProvider,
+                                          SequenceWorkflow)
+    _seed()
+    return SequenceWorkflow(
+        DummyLauncher(),
+        provider=SequenceProvider(n_train=4096, n_valid=256,
+                                  seq=128, dim=256),
+        minibatch_size=64, heads=8, moe=True, n_experts=8,
+        max_epochs=1)
+
+
+def bench_som():
+    """SOM has no GD chain: time the jitted batch update directly —
+    that IS config 4's training compute path (nn/kohonen.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy
+
+    from veles_tpu.nn.kohonen import _make_grid, _som_update
+
+    sx = sy = 8
+    features = 784
+    batch = 1024
+    rng = numpy.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, features).astype(numpy.float32))
+    codebook = jnp.asarray(
+        rng.rand(sx * sy, features).astype(numpy.float32) * 0.2 - 0.1)
+    grid = jnp.asarray(_make_grid(sx, sy))
+    sigma, lr = numpy.float32(2.0), numpy.float32(0.1)
+
+    codebook, win = _som_update(codebook, x, grid, sigma, lr)
+    win.block_until_ready()  # compile
+    steps = 0
+    start = time.time()
+    while True:
+        for _ in range(50):
+            codebook, win = _som_update(codebook, x, grid, sigma, lr)
+        win.block_until_ready()
+        steps += 50
+        elapsed = time.time() - start
+        if elapsed >= MIN_WINDOW_S:
+            break
+    rate = steps * batch / elapsed
+    # two (batch x units x features) dots per update
+    flops = 4.0 * sx * sy * features
+    return rate, flops, "Kohonen 8x8 SOM (batch 1024)"
+
+
+CONFIGS = {
+    "fc": (build_fc, "MNIST FC 784-100-10 (config 1, batch 500)"),
+    "conv": (build_conv, "MNIST conv 16c5-32c5 (config 2, batch 250)"),
+    "cifar": (build_cifar,
+              "CIFAR cifar10-quick (config 2, batch 250)"),
+    "ae": (build_ae, "MNIST AE 784-100-784 (config 4, batch 500)"),
+    "attention": (build_attention,
+                  "attention 2L seq=256 d=256 h=8 (batch 64)"),
+    "moe": (build_moe,
+            "attention+MoE 8 experts seq=128 d=256 (batch 64)"),
+}
+
+
+def main():
+    from veles_tpu.backends import Device
+    from veles_tpu.nn.precision import set_policy
+
+    import bench  # repo-root bench.py: shared matmul-peak measurement
+
+    names = sys.argv[1:] or list(CONFIGS) + ["som"]
+    set_policy(PRECISION)
+    peak = bench.measured_matmul_peak_tflops()
+    print("chip matmul peak: %.1f TF/s, policy=%s, window>=%.0fs"
+          % (peak, PRECISION, MIN_WINDOW_S), file=sys.stderr)
+
+    print("| Config | samples/s | model GFLOP/sample | eff TFLOP/s "
+          "| MFU |")
+    print("|---|---|---|---|---|")
+    for name in names:
+        t0 = time.time()
+        if name == "som":
+            rate, flops, label = bench_som()
+        else:
+            build, label = CONFIGS[name]
+            wf = build()
+            wf.initialize(device=Device(backend=None))
+            flops = bench.model_train_flops_per_sample(wf)
+            rate = _bench_fused(wf)
+        eff = rate * flops / 1e12
+        print("| %s | **%s** | %.3f | %.2f | %.1f%% |"
+              % (label,
+                 ("{:,.0f}".format(rate)), flops / 1e9, eff,
+                 100.0 * eff / peak), flush=True)
+        print("%s: %.1f samples/s in %.0fs total"
+              % (name, rate, time.time() - t0), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
