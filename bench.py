@@ -65,6 +65,25 @@ def model_train_flops_per_sample(wf):
     return total
 
 
+def prepare_segment_run(trainer, warm=2, seed=0):
+    """(params, states, idx, keys) after ``warm`` compiled segments —
+    the warm-up/settle discipline shared by bench.py,
+    scripts/bench_all.py and scripts/profile_step.py: the first warm
+    segment pays the XLA compile, the second absorbs the one-time
+    donated-buffer re-layout so what follows is pure steady state."""
+    import jax
+    import jax.numpy as jnp
+
+    idx = jnp.asarray(trainer._segment_indices(2))
+    keys = jax.random.split(jax.random.PRNGKey(seed), idx.shape[0])
+    params, states = trainer.pull_params()
+    for _ in range(warm):
+        params, states, losses, _ = trainer._train_segment(
+            params, states, idx, keys)
+        float(losses[-1])
+    return params, states, idx, keys
+
+
 def timed_segment_window(trainer, params, states, idx, keys,
                          min_window_s):
     """The phase-2 window discipline, shared with

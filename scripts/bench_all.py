@@ -181,7 +181,8 @@ def bench_som():
 
 CONFIGS = {
     "fc": (build_fc, "MNIST FC 784-100-10 (config 1, batch 500)"),
-    "conv": (build_conv, "MNIST conv 16c5-32c5 (config 2, batch 250)"),
+    "conv": (build_conv,
+             "MNIST conv 16c5-32c5 (config 2 analog, batch 250)"),
     "cifar": (build_cifar,
               "CIFAR cifar10-quick (config 2, batch 250)"),
     "ae": (build_ae, "MNIST AE 784-100-784 (config 4, batch 500)"),
@@ -218,7 +219,7 @@ def main():
             flops = bench.model_train_flops_per_sample(wf)
             rate = _bench_fused(wf)
         eff = rate * flops / 1e12
-        print("| %s | **%s** | %.3f | %.2f | %.1f%% |"
+        print("| %s | **%s** | %.4f | %.2f | %.1f%% |"
               % (label,
                  ("{:,.0f}".format(rate)), flops / 1e9, eff,
                  100.0 * eff / peak), flush=True)

@@ -1,0 +1,235 @@
+#!/usr/bin/env python3
+"""Profile the fused AlexNet train step and print per-op attribution
+(VERDICT r4 next #4: pin the MFU story from a trace, not ablations).
+
+Captures a ``jax.profiler`` trace of steady-state compiled segments
+(same discipline as bench.py's timed window: warm first, then trace),
+parses the xplane protobuf, and aggregates the device plane's
+synchronous op line ('XLA Ops', exclusive durations) three ways:
+
+* top ops by device time;
+* by SOURCE LINE (XLA carries ``source=veles_tpu/nn/<file>:<line>``
+  per op — the repo's own layer attribution, no guessing);
+* achieved FLOP/s and HBM GB/s per source bucket from the ``flops`` /
+  ``bytes_accessed`` stats — the direct test of the bandwidth-floor
+  claim in docs/PERF.md.
+
+Usage: python scripts/profile_step.py [trace_dir]
+Env: VELES_PROFILE_SEGMENTS (default 2) — segments inside the trace.
+"""
+
+import collections
+import glob
+import logging
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+logging.disable(logging.WARNING)
+
+N_TRAIN = int(os.environ.get("VELES_BENCH_NTRAIN", 2048))
+BATCH = int(os.environ.get("VELES_BENCH_BATCH", 128))
+SEGMENTS = int(os.environ.get("VELES_PROFILE_SEGMENTS", 2))
+PRECISION = os.environ.get("VELES_BENCH_PRECISION", "bfloat16")
+
+
+def build_trainer():
+    from veles_tpu import prng
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.alexnet import (ALEXNET_LAYERS,
+                                          AlexNetWorkflow,
+                                          SyntheticImageLoader)
+    from veles_tpu.nn.precision import set_policy
+    from veles_tpu.train import FusedTrainer
+
+    set_policy(PRECISION)
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    wf = AlexNetWorkflow(
+        DummyLauncher(),
+        loader_factory=lambda w: SyntheticImageLoader(
+            w, n_train=N_TRAIN, n_valid=BATCH, side=227,
+            n_classes=1000, minibatch_size=BATCH, dtype="bfloat16"),
+        layers=ALEXNET_LAYERS, max_epochs=1)
+    wf.initialize(device=Device(backend=None))
+    return FusedTrainer(wf)
+
+
+def capture(trace_dir):
+    import jax
+    import jax.numpy as jnp
+
+    trainer = build_trainer()
+    idx = jnp.asarray(trainer._segment_indices(2))
+    keys = jax.random.split(jax.random.PRNGKey(0), idx.shape[0])
+    params, states = trainer.pull_params()
+    for _ in range(2):  # compile + settle OUTSIDE the trace
+        params, states, losses, _ = trainer._train_segment(
+            params, states, idx, keys)
+        float(losses[-1])
+    t0 = time.time()
+    with jax.profiler.trace(trace_dir):
+        for _ in range(SEGMENTS):
+            params, states, losses, _ = trainer._train_segment(
+                params, states, idx, keys)
+        float(losses[-1])
+    wall = time.time() - t0
+    print("traced %d segments (%d steps) in %.2fs"
+          % (SEGMENTS, SEGMENTS * idx.shape[0], wall), file=sys.stderr)
+    return wall, SEGMENTS * idx.shape[0]
+
+
+def _load_xplanes(trace_dir):
+    try:
+        from xprof.protobuf import xplane_pb2
+    except ImportError:
+        # this environment's xprof wheel ships no xplane proto; the
+        # tensorflow bundle's tsl copy is the same message
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(
+        trace_dir, "**", "*.xplane.pb"), recursive=True)
+    if not paths:
+        raise FileNotFoundError("no xplane.pb under %s" % trace_dir)
+    spaces = []
+    for path in paths:
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        spaces.append(xs)
+    return spaces
+
+
+def _structural(name):
+    # umbrella ops that CONTAIN the real work on the same line:
+    # counting them would double every child
+    return (name.startswith("%while") or name.startswith("jit_")
+            or name.isdigit() or name.startswith("%call"))
+
+
+def op_records(trace_dir):
+    """[{name, dur_s, source, category, flops, bytes}] from the device
+    plane's 'XLA Ops' line (host '/host:CPU' fallback for CPU runs)."""
+    spaces = _load_xplanes(trace_dir)
+
+    def collect(plane, line_filter):
+        stat_names = {mid: m.name
+                      for mid, m in plane.stat_metadata.items()}
+        metas = {}
+        for mid, meta in plane.event_metadata.items():
+            stats = {}
+            for st in meta.stats:
+                key = stat_names.get(st.metadata_id)
+                stats[key] = (st.str_value or st.ref_value or
+                              st.int64_value)
+            metas[mid] = (meta.name, stats)
+        per_op = {}
+        for line in plane.lines:
+            if line_filter is not None and line.name != line_filter:
+                continue
+            for ev in line.events:
+                name, stats = metas.get(ev.metadata_id,
+                                        (str(ev.metadata_id), {}))
+                if _structural(name):
+                    continue
+                rec = per_op.setdefault(name, {
+                    "name": name, "dur_s": 0.0,
+                    "source": str(stats.get("source", "")),
+                    "category": str(stats.get("hlo_category", "")),
+                    "flops": int(stats.get("flops", 0) or 0),
+                    "bytes": int(stats.get("bytes_accessed", 0) or 0),
+                    "calls": 0})
+                rec["dur_s"] += ev.duration_ps / 1e12
+                rec["calls"] += 1
+        return list(per_op.values())
+
+    for tier, line_filter in (("device", "XLA Ops"), ("host", None)):
+        best = None
+        for xs in spaces:
+            for plane in xs.planes:
+                is_device = ("TPU" in plane.name or
+                             "/device:" in plane.name)
+                want = (is_device if tier == "device"
+                        else "/host:CPU" in plane.name)
+                if not want:
+                    continue
+                recs = collect(plane, line_filter)
+                total = sum(r["dur_s"] for r in recs)
+                if recs and (best is None or total > best[1]):
+                    best = (plane.name, total, recs)
+        if best is not None:
+            return best
+    raise RuntimeError("no plane with events found")
+
+
+def per_op_table(trace_dir):
+    """(plane, total_s, [(name, dur_s, pct)]) — compat summary."""
+    plane, total, recs = op_records(trace_dir)
+    rows = [(r["name"], r["dur_s"], 100.0 * r["dur_s"] / total)
+            for r in sorted(recs, key=lambda r: -r["dur_s"])]
+    return plane, total, rows
+
+
+def _source_bucket(rec):
+    src = rec["source"]
+    if "veles_tpu" in src:
+        # veles_tpu/nn/normalization.py:34 -> nn/normalization.py:34
+        return src.split("veles_tpu/", 1)[1]
+    if src:
+        return os.path.basename(src)
+    cat = rec["category"] or "uncategorized"
+    return "<no source: %s>" % cat
+
+
+def main():
+    args = [a for a in sys.argv[1:] if a != "--reuse"]
+    reuse = "--reuse" in sys.argv
+    trace_dir = (args[0] if args
+                 else os.path.join("/tmp", "veles_profile_%d"
+                                   % os.getpid()))
+    if reuse:
+        wall, steps = 0.0, SEGMENTS * (N_TRAIN // BATCH)
+    else:
+        wall, steps = capture(trace_dir)
+    plane, total_s, recs = op_records(trace_dir)
+    ms = 1e3 / steps  # per-step scale
+    print("device plane: %s — %.3fs op time over %d steps "
+          "(%.2f ms/step; wall %.2fs incl. host)"
+          % (plane, total_s, steps, total_s * ms, wall))
+
+    print()
+    print("top ops (per step):")
+    print("| op | source | ms/step | % | TFLOP/s | GB/s |")
+    print("|---|---|---|---|---|---|")
+    for r in sorted(recs, key=lambda r: -r["dur_s"])[:20]:
+        # flops/bytes stats are per CALL; dur_s is summed over calls
+        per_call = r["dur_s"] / max(r["calls"], 1)
+        tf = r["flops"] / per_call / 1e12 if per_call else 0.0
+        gb = r["bytes"] / per_call / 1e9 if per_call else 0.0
+        print("| `%s` | %s | %.2f | %.1f%% | %.1f | %.0f |"
+              % (r["name"].split(" = ")[0][:40],
+                 _source_bucket(r), r["dur_s"] * ms,
+                 100.0 * r["dur_s"] / total_s, tf, gb))
+
+    print()
+    print("by source line (layer attribution):")
+    print("| source | ms/step | % | avg GB/s |")
+    print("|---|---|---|---|")
+    buckets = collections.defaultdict(lambda: [0.0, 0.0])
+    for r in recs:
+        b = buckets[_source_bucket(r)]
+        b[0] += r["dur_s"]
+        b[1] += r["bytes"] * r["calls"]
+    for src, (secs, byts) in sorted(buckets.items(),
+                                    key=lambda kv: -kv[1][0]):
+        print("| %s | %.2f | %.1f%% | %.0f |"
+              % (src, secs * ms, 100.0 * secs / total_s,
+                 byts / secs / 1e9 if secs else 0.0))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -317,6 +317,31 @@ class TestPrecisionPolicy:
         np.testing.assert_allclose(np.asarray(ymix), np.asarray(y32),
                                    atol=0.05)
 
+    def test_avg_pooling_trains_under_bf16(self):
+        """Regression (r5, found by scripts/bench_all): AvgPooling's
+        depthwise-conv window sum used preferred_element_type=f32,
+        whose conv vjp rejects the f32-cotangent-vs-bf16-operand mix —
+        the CIFAR stack (the only avg_pooling topology) crashed on the
+        first fused train step under the bfloat16 policy."""
+        import numpy as np
+        from veles_tpu.nn.pooling import AvgPooling
+        from veles_tpu.nn.precision import set_policy
+
+        unit = AvgPooling.__new__(AvgPooling)
+        unit.kx = unit.ky = 3
+        unit.sliding = (2, 2)
+        x32 = jnp.asarray(
+            np.random.RandomState(0).rand(2, 9, 9, 4).astype("f"))
+        y32 = unit.apply({}, x32)
+        set_policy("bfloat16")
+        x16 = x32.astype(jnp.bfloat16)
+        loss = lambda x: jnp.sum(unit.apply({}, x) ** 2)
+        g = jax.grad(loss)(x16)  # crashed before the fix
+        assert g.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(unit.apply({}, x16), dtype="f"),
+            np.asarray(y32), atol=0.02)
+
     def test_training_converges_under_mixed(self):
         """A fused MNIST run under bf16_mixed reaches f32-class error."""
         import sys

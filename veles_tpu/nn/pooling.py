@@ -74,13 +74,18 @@ class AvgPooling(PoolingBase):
             x = x[..., None]
         channels = x.shape[-1]
         kernel = jnp.ones((self.ky, self.kx, 1, channels), x.dtype)
+        # no preferred_element_type: lax.conv's vjp rejects a widened
+        # output dtype (f32 cotangent conv'd against bf16 operands
+        # crashes the backward pass under the bfloat16 policy — same
+        # constraint as nn/conv.py apply; found by bench_all r5). The
+        # window sum of <=few dozen elements loses at most one bf16
+        # rounding, which the policy already accepts per layer.
         summed = jax.lax.conv_general_dilated(
             x, kernel, window_strides=(self.sliding[1], self.sliding[0]),
             padding="VALID",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=channels,
-            preferred_element_type=jnp.float32)
-        return summed / float(self.kx * self.ky)
+            feature_group_count=channels)
+        return summed / jnp.asarray(self.kx * self.ky, x.dtype)
 
 
 class Depooling(PoolingBase):
