@@ -67,7 +67,7 @@ def model_train_flops_per_sample(wf):
 
 def prepare_segment_run(trainer, warm=2, seed=0):
     """(params, states, idx, keys) after ``warm`` compiled segments —
-    the warm-up/settle discipline shared by bench.py,
+    THE warm-up/settle discipline, called by bench.py main,
     scripts/bench_all.py and scripts/profile_step.py: the first warm
     segment pays the XLA compile, the second absorbs the one-time
     donated-buffer re-layout so what follows is pure steady state."""
@@ -77,10 +77,13 @@ def prepare_segment_run(trainer, warm=2, seed=0):
     idx = jnp.asarray(trainer._segment_indices(2))
     keys = jax.random.split(jax.random.PRNGKey(seed), idx.shape[0])
     params, states = trainer.pull_params()
-    for _ in range(warm):
+    t0 = time.time()
+    for i in range(warm):
         params, states, losses, _ = trainer._train_segment(
             params, states, idx, keys)
         float(losses[-1])
+        print("warmup segment %d done: %.1fs" % (i, time.time() - t0),
+              file=sys.stderr, flush=True)
     return params, states, idx, keys
 
 
@@ -173,26 +176,20 @@ def main():
     print("trainer build (incl. s2d staging upload): %.0fs, staged=%s"
           % (time.time() - t0, trainer._staged_s2d),
           file=sys.stderr, flush=True)
-    params, states = trainer.pull_params()
-    # host-side snapshot of the fresh model: the warmup DONATES these
-    # device buffers, so the timed window re-uploads from here to start
-    # from an untrained model (live descending loss)
-    host_init = jax.tree_util.tree_map(numpy.asarray, (params, states))
-    idx = trainer._segment_indices(2)  # TRAIN segment index matrix
-    keys = jax.random.split(jax.random.PRNGKey(0), idx.shape[0])
-    idx = jnp.asarray(idx)
+    # host-side snapshot of the fresh model: the warmup DONATES the
+    # pulled device buffers, so the timed window re-uploads from here
+    # to start from an untrained model (live descending loss)
+    host_init = jax.tree_util.tree_map(numpy.asarray,
+                                       trainer.pull_params())
 
     # warm-up: TWO segments — the first pays the XLA compile (cheap on
     # re-runs via the persistent cache in ~/.veles_tpu/cache/xla), the
     # second absorbs the one-time donated-buffer re-layout so the timed
-    # region is pure steady state
+    # region is pure steady state (prepare_segment_run: the discipline
+    # shared with scripts/bench_all.py and scripts/profile_step.py)
     t_compile = time.time()
-    for i in range(2):
-        params, states, losses, _ = trainer._train_segment(
-            params, states, idx, keys)
-        float(losses[-1])
-        print("warmup segment %d done: %.1fs" % (i, time.time() - t_compile),
-              file=sys.stderr, flush=True)
+    params, states, idx, keys = prepare_segment_run(trainer, warm=2,
+                                                    seed=0)
     print("warmup (compile + settle): %.1fs" % (time.time() - t_compile),
           file=sys.stderr, flush=True)
 

@@ -41,22 +41,15 @@ def _seed():
 
 
 def _bench_fused(wf):
-    """Steady samples/s with bench.py's shared phase-2 discipline
-    (2 warm segments pay compile + settle, then the timed window)."""
-    import jax
-    import jax.numpy as jnp
-
+    """Steady samples/s with bench.py's shared disciplines
+    (prepare_segment_run pays compile + settle, then the timed
+    window)."""
     import bench
 
     from veles_tpu.train import FusedTrainer
     trainer = FusedTrainer(wf)
-    idx = jnp.asarray(trainer._segment_indices(2))
-    keys = jax.random.split(jax.random.PRNGKey(0), idx.shape[0])
-    params, states = trainer.pull_params()
-    for _ in range(2):
-        params, states, losses, _ = trainer._train_segment(
-            params, states, idx, keys)
-        float(losses[-1])
+    params, states, idx, keys = bench.prepare_segment_run(
+        trainer, warm=2, seed=0)
     params, states, segs, elapsed, _ = bench.timed_segment_window(
         trainer, params, states, idx, keys, MIN_WINDOW_S)
     mb = trainer.workflow.loader.max_minibatch_size

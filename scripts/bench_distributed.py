@@ -18,6 +18,10 @@ measures the pieces separately and honestly:
 * ``shmbench`` — wire-encode + decode + memcpy of the REAL AlexNet-227
   parameter set (the per-job exchange payload) on this host: the
   numerator of the exchange-cost ratio on ANY same-host deployment.
+  Reports three codecs side by side — the r5 full-pickle baseline,
+  the out-of-band array framing (this repo's default shm path), and
+  the ``--exchange-dtype bfloat16`` delta push — with per-phase times
+  and the speedup vs pickle (docs/PERF.md r6).
 * default (chip) — standalone vs master+1 slave on the chip with the
   MNIST-FC config (config 1; weights 0.32 MB). NOTE on this
   environment: the chip is reached through a tunneled relay measured
@@ -172,90 +176,225 @@ def run_slave(port):
     print(json.dumps({"leg": "slave", "ok": True}))
 
 
+def _alexnet_payload(rng, scale=1.0):
+    """The real AlexNet-227 stored parameter set (conv kernels + fc
+    trunk), f32; conv1 is (ky, kx, 3, 96) — the s2d regrouping happens
+    at apply time, never in the exchanged arrays."""
+    import numpy
+    shapes = [(11, 11, 3, 96), (96,), (5, 5, 96, 256), (256,),
+              (3, 3, 256, 384), (384,), (3, 3, 384, 384), (384,),
+              (3, 3, 384, 256), (256,), (9216, 4096), (4096,),
+              (4096, 4096), (4096,), (4096, 1000), (1000,)]
+    return {"w%d" % i: (rng.randn(*s) * scale).astype(numpy.float32)
+            for i, s in enumerate(shapes)}
+
+
 def run_shmbench():
     """Per-job weight-exchange cost at FLAGSHIP scale on this host:
-    wire-encode the real AlexNet-227 parameter arrays, memcpy through
-    a SharedMemory segment, decode — the full shm fast-path payload
-    cycle, no device involved."""
+    encode the real AlexNet-227 parameter set, memcpy through ONE
+    reused SharedMemory segment, copy out, decode — the full shm
+    fast-path payload cycle, no device involved. Three codecs:
+
+    * ``pickle``  — the r5 baseline (full pickle byte-string both ways);
+    * ``oob``     — out-of-band framing: skeleton pickle + raw array
+      buffers memcpy'd straight into the segment, decode =
+      zero-copy ``frombuffer`` views (this PR's default shm path);
+    * ``delta16`` — oob + ``--exchange-dtype bfloat16`` steady-state
+      delta push (half the bytes; the first full push is excluded,
+      it happens once per slave connection).
+
+    The segment is allocated once and reused across cycles, like the
+    Protocol's double-buffered segments in a real run. Reports the
+    best-of-N cycle per codec and the speedups over pickle.
+    """
+    import pickle
     from multiprocessing import shared_memory
 
     import numpy
 
     from veles_tpu.parallel import wire
 
+    cycles = int(os.environ.get("VELES_SHMBENCH_CYCLES", 5))
     rng = numpy.random.RandomState(0)
-    # AlexNet-227 stored parameter set (conv kernels + fc trunk), f32;
-    # conv1 is (ky, kx, 3, 96) — the s2d regrouping happens at apply
-    # time, never in the exchanged arrays
-    shapes = [(11, 11, 3, 96), (96,), (5, 5, 96, 256), (256,),
-              (3, 3, 256, 384), (384,), (3, 3, 384, 384), (384,),
-              (3, 3, 384, 256), (256,), (9216, 4096), (4096,),
-              (4096, 4096), (4096,), (4096, 1000), (1000,)]
-    payload = {"w%d" % i: rng.randn(*s).astype(numpy.float32)
-               for i, s in enumerate(shapes)}
+    payload = _alexnet_payload(rng)
+    # a second weight state one SGD-sized step away, so delta cycles
+    # encode a real nonzero delta every time
+    stepped = {k: v + 0.001 * rng.randn(*v.shape).astype(numpy.float32)
+               for k, v in payload.items()}
     total_mb = sum(a.nbytes for a in payload.values()) / 1e6
 
-    t = time.time()
-    blob = wire.encode(payload, compress=False)
-    t_enc = time.time() - t
-    seg = shared_memory.SharedMemory(create=True, size=len(blob))
-    try:
-        t = time.time()
+    def cycle_pickle(seg, tree):
+        t0 = time.time()
+        blob = wire.RAW + pickle.dumps(tree, protocol=4)
+        t1 = time.time()
         seg.buf[:len(blob)] = blob
-        t_copy_in = time.time() - t
-        t = time.time()
+        t2 = time.time()
         out = bytes(seg.buf[:len(blob)])
-        t_copy_out = time.time() - t
-        t = time.time()
+        t3 = time.time()
         wire.decode(out)
-        t_dec = time.time() - t
+        t4 = time.time()
+        return (t1 - t0, t2 - t1, t3 - t2, t4 - t3), len(blob)
+
+    def cycle_oob(seg, tree):
+        t0 = time.time()
+        chunks = wire.encode_chunks(tree)
+        t1 = time.time()
+        pos = 0
+        for part in chunks.parts:
+            seg.buf[pos:pos + len(part)] = part
+            pos += len(part)
+        t2 = time.time()
+        out = bytes(seg.buf[:pos])
+        t3 = time.time()
+        tree = wire.decode(out)
+        # touch one element per leaf so lazy views cannot hide work
+        for arr in tree.values():
+            arr.ravel()[0]
+        t4 = time.time()
+        return (t1 - t0, t2 - t1, t3 - t2, t4 - t3), chunks.nbytes
+
+    def run_leg(fn, seg, trees):
+        best, wire_bytes = None, 0
+        for i in range(cycles):
+            times, nbytes = fn(seg, trees[i % len(trees)])
+            if best is None or sum(times) < sum(best):
+                best, wire_bytes = times, nbytes
+        return best, wire_bytes
+
+    # pickle baseline sizing: tag + full pickle
+    probe = wire.RAW + pickle.dumps(payload, protocol=4)
+    seg = shared_memory.SharedMemory(create=True,
+                                     size=len(probe) + (1 << 20))
+    rows = {}
+    try:
+        rows["pickle"] = run_leg(cycle_pickle, seg, [payload, stepped])
+        rows["oob"] = run_leg(cycle_oob, seg, [payload, stepped])
+
+        enc = wire.DeltaEncoder(dtype="bfloat16")
+        dec = wire.DeltaDecoder()
+        # untimed first full push primes both codecs' bases to
+        # ``payload``; starting the flip at ``stepped`` makes every
+        # timed cycle carry a real full-size delta (starting at
+        # ``payload`` would make cycle 0 an all-leaves-skipped no-op)
+        dec.decode(wire.decode(wire.encode_chunks(
+            enc.encode(payload)).join()))
+        flip = [stepped, payload]
+
+        def cycle_delta(seg, tree):
+            t0 = time.time()
+            chunks = wire.encode_chunks(enc.encode(tree))
+            t1 = time.time()
+            pos = 0
+            for part in chunks.parts:
+                seg.buf[pos:pos + len(part)] = part
+                pos += len(part)
+            t2 = time.time()
+            out = bytes(seg.buf[:pos])
+            t3 = time.time()
+            dec.decode(wire.decode(out))
+            t4 = time.time()
+            return (t1 - t0, t2 - t1, t3 - t2, t4 - t3), chunks.nbytes
+
+        rows["delta16"] = run_leg(cycle_delta, seg, flip)
     finally:
         seg.close()
         seg.unlink()
-    cycle = t_enc + t_copy_in + t_copy_out + t_dec
-    print(json.dumps({
-        "leg": "shmbench", "payload_mb": round(total_mb, 1),
-        "encode_s": round(t_enc, 3), "shm_in_s": round(t_copy_in, 3),
-        "shm_out_s": round(t_copy_out, 3),
-        "decode_s": round(t_dec, 3),
-        "full_cycle_s": round(cycle, 3),
-        "mb_per_s": round(total_mb / cycle, 0)}))
+
+    report = {"leg": "shmbench", "payload_mb": round(total_mb, 1),
+              "cycles": cycles}
+    base = sum(rows["pickle"][0])
+    for name, (times, wire_bytes) in rows.items():
+        enc_s, in_s, out_s, dec_s = times
+        cyc = sum(times)
+        report[name] = {
+            "encode_s": round(enc_s, 4), "shm_in_s": round(in_s, 4),
+            "shm_out_s": round(out_s, 4), "decode_s": round(dec_s, 4),
+            "full_cycle_s": round(cyc, 4),
+            "wire_mb": round(wire_bytes / 1e6, 1),
+            "mb_per_s": round(total_mb / cyc, 0),
+            "speedup_vs_pickle": round(base / cyc, 2)}
+    print(json.dumps(report))
 
 
 # -- orchestration ---------------------------------------------------------
 
 
-def _spawn(mode, *args, tpu, extra_env=None):
+#: overall ceiling on any single leg — a hung-but-alive subprocess
+#: must fail the harness loudly instead of blocking it forever
+LEG_TIMEOUT = float(os.environ.get("VELES_DIST_TIMEOUT", 1800))
+
+
+def _spawn(mode, *args, tpu, extra_env=None, tag=None):
+    """Start a leg subprocess with BACKGROUND pipe pumps: stderr lines
+    are forwarded (tagged) as they arrive and stdout lines collected —
+    so a slave producing >64 KB of output can never fill its pipe and
+    deadlock the harness against a blocked master."""
     env = dict(os.environ)
     if not tpu:
         env["JAX_PLATFORMS"] = "cpu"
         env["VELES_TPU_BACKEND"] = "cpu"
     env.update(extra_env or {})
-    return subprocess.Popen(
+    proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), mode] +
         [str(a) for a in args],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True)
+    proc.tag = tag or mode
+    proc.out_lines = []
+    proc.port = None
+    proc.port_seen = threading.Event()
+
+    def pump_err():
+        for line in proc.stderr:
+            if line.startswith("PORT="):
+                proc.port = int(line.split("=", 1)[1].strip())
+                proc.port_seen.set()
+            sys.stderr.write("[%s] %s" % (proc.tag, line))
+        proc.port_seen.set()  # EOF: unblock _wait_port on early death
+
+    def pump_out():
+        for line in proc.stdout:
+            proc.out_lines.append(line)
+
+    proc.pumps = [threading.Thread(target=pump_err, daemon=True),
+                  threading.Thread(target=pump_out, daemon=True)]
+    for t in proc.pumps:
+        t.start()
+    return proc
 
 
-def _wait_port(proc):
-    for line in proc.stderr:
-        sys.stderr.write("[master] " + line)
-        if line.startswith("PORT="):
-            return int(line.split("=", 1)[1])
-    raise RuntimeError("master died before binding")
+def _wait_port(proc, timeout=900):
+    proc.port_seen.wait(timeout)
+    if proc.port is None:
+        if proc.poll() is None:
+            # hung before binding: don't orphan it holding the device
+            proc.kill()
+            proc.wait()
+        raise RuntimeError("master died or hung before binding")
+    return proc.port
 
 
-def _drain(proc, tag):
-    out, err = proc.communicate()
-    for line in err.splitlines():
-        sys.stderr.write("[%s] %s\n" % (tag, line))
+def _drain(proc, tag, timeout=None):
+    """Wait for a leg (bounded), join its pumps, parse the last JSON
+    stdout line. The pipe pumps already ran in the background, so this
+    cannot deadlock on full pipes; the timeout covers a leg that hangs
+    while alive."""
+    try:
+        proc.wait(timeout=LEG_TIMEOUT if timeout is None else timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        raise RuntimeError("%s leg hung; killed after %.0fs"
+                           % (tag, LEG_TIMEOUT if timeout is None
+                              else timeout))
+    for t in proc.pumps:
+        t.join(timeout=10)
     payload = None
-    for line in out.splitlines():
+    for line in proc.out_lines:
         try:
             payload = json.loads(line)
         except ValueError:
-            sys.stderr.write("[%s] %s\n" % (tag, line))
+            sys.stderr.write("[%s] %s" % (tag, line))
     if proc.returncode != 0:
         raise RuntimeError("%s leg failed (rc=%d)"
                            % (tag, proc.returncode))
@@ -266,8 +405,9 @@ def _one_round(n_slaves, tpu_slave, config):
     env = {"VELES_DIST_CONFIG": config}
     master = _spawn("master", n_slaves, tpu=False, extra_env=env)
     port = _wait_port(master)
-    slaves = [_spawn("slave", port, tpu=tpu_slave, extra_env=env)
-              for _ in range(n_slaves)]
+    slaves = [_spawn("slave", port, tpu=tpu_slave, extra_env=env,
+                     tag="slave%d" % i)
+              for i in range(n_slaves)]
 
     # a slave dying at startup would leave the master waiting and the
     # parent blocked on it with the slave's stderr never surfaced —
@@ -292,7 +432,9 @@ def _one_round(n_slaves, tpu_slave, config):
             if s.poll() is None and master.poll() is not None:
                 s.kill()
             try:
-                _drain(s, "slave%d" % i)
+                # slaves exit right after the master; anything still
+                # alive here is wedged — bound the wait tightly
+                _drain(s, "slave%d" % i, timeout=60)
             except RuntimeError as e:
                 sys.stderr.write("%s\n" % e)
     return dist

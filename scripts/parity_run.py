@@ -107,7 +107,9 @@ def main():
         "",
         "Dataset: %s" % dataset,
         "",
-        "| Config | val error | target | reference context | train s |",
+        # unit-neutral label: rows carry % error, RMSE and raw
+        # quantization error (each row names its unit)
+        "| Config | metric | target | reference context | train s |",
         "|---|---|---|---|---|",
     ]
     ok = True

@@ -61,16 +61,13 @@ def build_trainer():
 
 def capture(trace_dir):
     import jax
-    import jax.numpy as jnp
+
+    import bench  # repo-root bench.py: shared warm-up discipline
 
     trainer = build_trainer()
-    idx = jnp.asarray(trainer._segment_indices(2))
-    keys = jax.random.split(jax.random.PRNGKey(0), idx.shape[0])
-    params, states = trainer.pull_params()
-    for _ in range(2):  # compile + settle OUTSIDE the trace
-        params, states, losses, _ = trainer._train_segment(
-            params, states, idx, keys)
-        float(losses[-1])
+    # compile + settle OUTSIDE the trace
+    params, states, idx, keys = bench.prepare_segment_run(
+        trainer, warm=2, seed=0)
     t0 = time.time()
     with jax.profiler.trace(trace_dir):
         for _ in range(SEGMENTS):

@@ -185,6 +185,154 @@ def test_spoofed_mid_does_not_enable_sharedio(monkeypatch):
         server.stop()
 
 
+def test_chunks_ride_shm_without_pickle_materialization():
+    """wire.encode_chunks payloads (out-of-band array framing) ride
+    the shm fast path as scatter/gather writes: each raw array buffer
+    is memcpy'd straight into the segment and the receiver decodes
+    zero-copy views — the ISSUE 2 flagship exchange path."""
+    import numpy
+    import socket as socket_mod
+    from veles_tpu.parallel import wire
+    from veles_tpu.parallel.coordinator import Protocol
+
+    a, b = socket_mod.socketpair()
+    tx, rx = Protocol(a), Protocol(b)
+    tx.enable_sharedio()
+    rx.enable_sharedio()
+    rng = numpy.random.RandomState(3)
+    tree = {"w": rng.randn(300, 300).astype("f4"),
+            "meta": {"epoch": 1}}
+    try:
+        tx.send({"blob": wire.encode_chunks(tree)})
+        out = wire.decode(rx.recv()["blob"])
+        numpy.testing.assert_array_equal(out["w"], tree["w"])
+        assert not out["w"].flags.owndata  # decoded as a view
+        assert tx.shm_sends == 1 and rx.shm_reads == 1
+        # same-size cycles REUSE the double-buffered segments: no
+        # regrow churn across a steady exchange loop
+        for _ in range(4):
+            tx.send({"blob": wire.encode_chunks(tree)})
+            rx.recv()
+        assert tx.shm_regrows == 0
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_chunks_ride_plain_socket_frames():
+    """Without shm (remote peer), Chunks are written back-to-back
+    under one binary-frame length prefix — the receiver sees ordinary
+    contiguous bytes."""
+    import numpy
+    import socket as socket_mod
+    from veles_tpu.parallel import wire
+    from veles_tpu.parallel.coordinator import Protocol
+
+    a, b = socket_mod.socketpair()
+    tx, rx = Protocol(a), Protocol(b)  # sharedio never enabled
+    tree = {"w": numpy.arange(2048, dtype=numpy.float32),
+            "tag": "frame"}
+    try:
+        tx.send({"blob": wire.encode_chunks(tree)})
+        out = wire.decode(rx.recv()["blob"])
+        numpy.testing.assert_array_equal(out["w"], tree["w"])
+        assert tx.shm_sends == 0
+    finally:
+        tx.close()
+        rx.close()
+
+
+def test_segment_growth_slack_absorbs_oscillation():
+    """A payload that grows within the 25% slack must reuse the
+    segment; only growth beyond the slack regrows. (Sends alternate
+    between the two double-buffered segments, so each size is sent
+    TWICE to land once on each turn.)"""
+    import socket as socket_mod
+    from veles_tpu.parallel.coordinator import Protocol
+
+    a, b = socket_mod.socketpair()
+    tx, rx = Protocol(a), Protocol(b)
+    tx.enable_sharedio()
+    rx.enable_sharedio()
+    small = b"s" * (100 * 1024)
+    bigger = b"b" * (110 * 1024)   # within small's 25% slack
+    too_big = b"B" * (200 * 1024)  # beyond it
+    try:
+        for blob in (small, small, bigger, bigger, small, small):
+            tx.send({"payload": blob})
+            assert rx.recv()["payload"] == blob
+        # both turns grew 100K -> 110K inside the slack: no regrows
+        # (without the slack this sequence regrows twice)
+        assert tx.shm_regrows == 0
+        for blob in (too_big, too_big):
+            tx.send({"payload": blob})
+            assert rx.recv()["payload"] == blob
+        assert tx.shm_regrows == 2  # genuine growth still regrows
+    finally:
+        tx.close()
+        rx.close()
+
+
+def _decision_for_epoch_test(max_epochs=3):
+    """A DecisionGD wired for master-side accounting: 2 train + 1
+    validation minibatches of 10 samples per epoch."""
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.nn.decision import DecisionGD
+
+    wf = DummyWorkflow()
+    d = DecisionGD(wf, max_epochs=max_epochs)
+    d.class_lengths = [0, 10, 20]  # test/validation/train samples
+    d.epoch_number = 0
+    return d
+
+
+def _updates(epoch):
+    """All of one epoch's per-minibatch stats (segment-update shape)."""
+    from veles_tpu.loader.base import TRAIN, VALIDATION
+    return ([{"klass": TRAIN, "samples": 10, "metric": 1.0,
+              "epoch": epoch, "last": False, "epoch_ended": False}
+             for _ in range(2)] +
+            [{"klass": VALIDATION, "samples": 10, "metric": 2.0,
+              "epoch": epoch, "last": True, "epoch_ended": True}])
+
+
+def test_epochs_close_in_order_despite_runahead_completion():
+    """ISSUE 2 regression: a fast slave completing ALL of epoch e+1
+    while a slow sibling still holds epoch e must NOT close e+1 first
+    — max_epochs would stop the run with epoch e permanently open
+    (epoch_history [0, 2] instead of [0, 1, 2])."""
+    d = _decision_for_epoch_test(max_epochs=3)
+    d.apply_data_from_slave(_updates(0), slave=None)
+    assert [h["epoch"] for h in d.epoch_history] == [0]
+    # epoch 2 (run-ahead) completes ENTIRELY before any epoch-1 update
+    # — and the loader has already advanced to epoch 3
+    d.epoch_number = 3
+    d.apply_data_from_slave(_updates(2), slave=None)
+    # parked, not closed; and the oldest OPEN epoch (1, which has no
+    # bucket yet) still gates run-ahead: 3 - 1 > 1 withholds jobs
+    assert [h["epoch"] for h in d.epoch_history] == [0]
+    assert not bool(d.complete)
+    assert not d.has_data_for_slave
+    # the laggard's epoch-1 updates arrive: 1 closes, then parked 2
+    d.apply_data_from_slave(_updates(1), slave=None)
+    assert [h["epoch"] for h in d.epoch_history] == [0, 1, 2]
+    assert bool(d.complete)  # max_epochs reached on the TRUE last epoch
+
+
+def test_stop_epoch_cancels_parked_runahead():
+    """Run-ahead epochs parked past the stop decision are discarded,
+    not closed into epoch_history."""
+    d = _decision_for_epoch_test(max_epochs=2)
+    d.apply_data_from_slave(_updates(0), slave=None)
+    # epoch 2 completes out of order (would be past the stop), then 1
+    d.epoch_number = 2
+    d.apply_data_from_slave(_updates(2), slave=None)
+    d.apply_data_from_slave(_updates(1), slave=None)
+    # max_epochs=2: stop at epoch 1; the parked epoch 2 is cancelled
+    assert [h["epoch"] for h in d.epoch_history] == [0, 1]
+    assert bool(d.complete)
+
+
 def test_restore_rejects_out_of_bounds_refs():
     """off/size outside the attached segment must raise, not silently
     truncate into a corrupt blob."""

@@ -330,6 +330,37 @@ def test_cli_interactive_scripted_session(workflow_file, tmp_path):
     assert "best_n_err_pt" in results
 
 
+def test_cli_interactive_double_main_skips_retrain(workflow_file,
+                                                   tmp_path):
+    """Calling main() twice inside the -i console must warn and skip:
+    a silent retrain from the trained state would also overwrite the
+    result file (ADVICE r5)."""
+    import subprocess
+    import sys as _sys
+
+    result_file = str(tmp_path / "res.json")
+    script = (
+        "main()\n"
+        "print('EPOCHS_ONE=%d' % len(workflow.decision.epoch_history))\n"
+        "main()\n"
+        "print('EPOCHS_TWO=%d' % len(workflow.decision.epoch_history))\n"
+    )
+    proc = subprocess.run(
+        [_sys.executable, "-m", "veles_tpu", workflow_file, "-s", "7",
+         "-i", "--result-file", result_file],
+        input=script.encode(),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        env={**os.environ,
+             "PYTHONPATH": os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__)))},
+        timeout=600)
+    out = proc.stdout.decode(errors="replace")
+    assert proc.returncode == 0, out[-2000:]
+    assert "EPOCHS_ONE=2" in out, out[-2000:]
+    assert "EPOCHS_TWO=2" in out, out[-2000:]  # second main() no-op'd
+    assert "already ran" in out, out[-2000:]
+
+
 def test_cli_interactive_exit_resumes_run(workflow_file, tmp_path):
     """-i with an empty stdin session: exiting the console without
     calling main() resumes the scheduler — the run still happens."""

@@ -29,10 +29,12 @@ def test_wire_compresses_large_compressible_payloads():
     blob = wire.encode({"w": numpy.zeros(100000, numpy.float32)})
     assert blob[:1] == wire.ZLIB
     assert len(blob) < 10000  # zeros compress hard
-    # same-host path skips the codec
+    # same-host path skips the codec; array payloads frame out-of-band
     raw = wire.encode({"w": numpy.zeros(100000, numpy.float32)},
                       compress=False)
-    assert raw[:1] == wire.RAW
+    assert raw[:1] == wire.OOB
+    # array-free payloads still ride the legacy pickle framing
+    assert wire.encode({"cmd": "x"}, compress=False)[:1] == wire.RAW
 
 
 def _make_workflow(launcher, max_epochs=3, seed=42):
@@ -44,9 +46,10 @@ def _make_workflow(launcher, max_epochs=3, seed=42):
 
 
 def _run_distributed(n_slaves=1, segment_size=8, slave_eager=False,
-                     max_epochs=3, pipeline=True):
+                     max_epochs=3, pipeline=True, exchange_dtype=None):
     master = Launcher(listen_address="127.0.0.1:0", graphics=False,
-                      segment_size=segment_size)
+                      segment_size=segment_size,
+                      exchange_dtype=exchange_dtype)
     wf_master = _make_workflow(master, max_epochs=max_epochs)
     master.initialize()
     port = master._server.address[1]
@@ -135,6 +138,35 @@ def test_eager_slave_serves_segment_master():
 def test_segment_size_one_reproduces_reference_protocol():
     wf, _ = _run_distributed(n_slaves=1, segment_size=1)
     assert len(wf.decision.epoch_history) == 3
+
+
+def test_bf16_delta_exchange_trains():
+    """--exchange-dtype bfloat16: after the first full push the master
+    sends per-leaf bf16 deltas; training must still converge (bounded
+    one-push quantization, async-SGD class like --pipeline)."""
+    wf, master = _run_distributed(n_slaves=1, segment_size=8,
+                                  exchange_dtype="bfloat16")
+    history = wf.decision.epoch_history
+    assert len(history) == 3
+    assert history[-1]["validation"]["normalized"] < 0.45
+
+
+def test_f32_delta_exchange_matches_full_push_closely():
+    """--exchange-dtype float32 (delta without the cast) must stay in
+    the same accuracy class as the full-push protocol — the delta
+    reconstruction differs only by f32 rounding per push."""
+    wf, _ = _run_distributed(n_slaves=1, segment_size=8,
+                             pipeline=False,
+                             exchange_dtype="float32")
+    wf_full, _ = _run_distributed(n_slaves=1, segment_size=8,
+                                  pipeline=False)
+    h_delta = wf.decision.epoch_history
+    h_full = wf_full.decision.epoch_history
+    assert len(h_delta) == len(h_full)
+    for hd, hf in zip(h_delta, h_full):
+        numpy.testing.assert_allclose(
+            hd["validation"]["normalized"],
+            hf["validation"]["normalized"], atol=0.02)
 
 
 def test_chaos_death_with_segments_requeues():

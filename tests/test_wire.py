@@ -1,0 +1,392 @@
+"""Out-of-band wire format + parameter-delta exchange (ISSUE 2).
+
+The OOB format (skeleton pickle + raw array buffer table) must
+round-trip every control-plane payload shape in both directions,
+decode arrays as zero-copy views, and — critically — keep the
+restricted-unpickle security property: raw buffers must not widen the
+unpickle surface the r3 hardening closed.
+"""
+
+import json
+import pickle
+import struct
+
+import numpy
+import pytest
+
+from veles_tpu.parallel import wire
+
+
+def _roundtrip(obj, **kw):
+    return wire.decode(wire.encode(obj, **kw))
+
+
+def _assert_tree_equal(a, b):
+    assert type(a) is type(b) or (
+        isinstance(a, numpy.ndarray) and isinstance(b, numpy.ndarray))
+    if isinstance(a, dict):
+        assert set(a) == set(b)
+        for k in a:
+            _assert_tree_equal(a[k], b[k])
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_tree_equal(x, y)
+    elif isinstance(a, numpy.ndarray):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        numpy.testing.assert_array_equal(
+            numpy.asarray(a, numpy.float64) if a.dtype.kind == "V"
+            else a,
+            numpy.asarray(b, numpy.float64) if b.dtype.kind == "V"
+            else b)
+    else:
+        assert a == b
+
+
+RNG = numpy.random.RandomState(7)
+
+
+class TestOutOfBandRoundTrip(object):
+    def test_segment_job_shape(self):
+        """The actual master->slave payload shape: unit payloads +
+        loader minibatches, arrays large and small, mixed dtypes."""
+        job = {
+            "units": [
+                ("gd_fc1", {"weights": RNG.randn(300, 400).astype("f4"),
+                            "bias": RNG.randn(400).astype("f4")}),
+                ("decision", {"epoch": 3, "reset": True,
+                              "stats": [0.5, 2]}),
+            ],
+            "batches": [
+                {"indices": numpy.arange(500, dtype=numpy.int32),
+                 "size": 500, "class": 2, "last": False, "epoch": 3,
+                 "epoch_ended": False},
+            ],
+        }
+        for compress in (False, True):
+            out = _roundtrip(job, compress=compress)
+            _assert_tree_equal(out, job)
+        # OOB engages on the uncompressed (same-host) path
+        assert wire.encode(job, compress=False)[:1] == wire.OOB
+
+    def test_empty_pytrees(self):
+        for obj in ({}, [], (), {"a": {}}, [[]], None, {"a": None}):
+            assert _roundtrip(obj, compress=False) == obj
+
+    def test_zero_d_arrays(self):
+        # below the OOB threshold (skeleton path) AND forced OOB
+        small = {"x": numpy.array(3.5), "y": numpy.float64(0.25)}
+        out = _roundtrip(small, compress=False)
+        assert float(out["x"]) == 3.5 and out["y"] == 0.25
+        leaves = []
+        skel = wire._extract(numpy.array(2.5), leaves)
+        assert not leaves and isinstance(skel, numpy.ndarray)
+
+    def test_non_contiguous_views(self):
+        base = RNG.randn(64, 64).astype("f4")
+        tree = {"strided": base[::2, ::3], "t": base.T,
+                "rev": base[::-1]}
+        out = _roundtrip(tree, compress=False)
+        for k in tree:
+            numpy.testing.assert_array_equal(out[k], tree[k])
+
+    def test_mixed_dtypes(self):
+        tree = {"f4": RNG.randn(1000).astype("f4"),
+                "f8": RNG.randn(300),
+                "i4": numpy.arange(400, dtype="i4"),
+                "i8": numpy.arange(200, dtype="i8"),
+                "u1": numpy.arange(256, dtype="u1").repeat(4),
+                "b": numpy.tile([True, False], 400),
+                "c8": (RNG.randn(200) + 1j * RNG.randn(200)).astype(
+                    "c8")}
+        _assert_tree_equal(_roundtrip(tree, compress=False), tree)
+
+    def test_datetime_arrays_stay_in_skeleton(self):
+        """datetime64/timedelta64 export no buffer — they must ride
+        the skeleton pickle instead of crashing the OOB extractor."""
+        tree = {"t": numpy.zeros(200, dtype="datetime64[D]"),
+                "dt": numpy.ones(200, dtype="timedelta64[s]"),
+                "w": RNG.randn(500).astype("f4")}
+        for blob in (wire.encode(tree, compress=False),
+                     wire.encode_chunks(tree).join()):
+            out = wire.decode(blob)
+            numpy.testing.assert_array_equal(out["t"], tree["t"])
+            numpy.testing.assert_array_equal(out["dt"], tree["dt"])
+            numpy.testing.assert_array_equal(out["w"], tree["w"])
+
+    def test_bf16_arrays(self):
+        ml_dtypes = pytest.importorskip("ml_dtypes")
+        arr = RNG.randn(64, 64).astype(ml_dtypes.bfloat16)
+        out = _roundtrip({"w": arr}, compress=False)
+        assert out["w"].dtype == arr.dtype
+        numpy.testing.assert_array_equal(
+            out["w"].astype("f4"), arr.astype("f4"))
+
+    def test_both_directions(self):
+        """Master->slave job and slave->master update shapes both
+        survive (the update is the gd-delta list form)."""
+        update = [("gd_fc1", {"weights": RNG.randn(100, 50).astype("f4")}),
+                  ("decision", [{"klass": 2, "samples": 500,
+                                 "metric": 0.25}]),
+                  ("loader", {"served": 4000, "count": 8})]
+        _assert_tree_equal(_roundtrip(update, compress=False), update)
+
+    def test_zero_copy_decode(self):
+        tree = {"w": RNG.randn(500, 40).astype("f4")}
+        out = wire.decode(wire.encode(tree, compress=False))
+        w = out["w"]
+        assert not w.flags.owndata  # a view over the blob, not a copy
+        assert not w.flags.writeable  # consumers must copy to mutate
+        assert w.flags.aligned  # the view is usable at full speed
+
+    def test_leaves_land_on_alignment_boundaries(self):
+        """Leaf offsets are OOB_ALIGN-aligned within the WHOLE blob
+        (tag included) — off-by-one here silently costs every numpy op
+        on decoded views the unaligned slow path."""
+        tree = {"a": RNG.randn(300).astype("f4"),   # 1200 B: goes OOB
+                "b": RNG.randn(777).astype("f8")}
+        blob = wire.encode(tree, compress=False)
+        out = wire.decode(blob)
+        base = numpy.frombuffer(blob, dtype=numpy.uint8)
+        for arr in out.values():
+            off = (arr.__array_interface__["data"][0] -
+                   base.__array_interface__["data"][0])
+            assert 0 < off < len(blob)  # really a view into the blob
+            assert off % wire.OOB_ALIGN == 0, off
+
+    def test_encode_chunks_zero_copy_and_join_parity(self):
+        src = RNG.randn(400, 100).astype("f4")
+        tree = {"w": src, "meta": 1}
+        blob = wire.encode(tree, compress=False)
+        chunks = wire.encode_chunks(tree)
+        assert chunks.join() == blob
+        # the chunk references the live array: mutating the source
+        # before the transport writes it changes the bytes (no copy)
+        src[0, 0] = 123.0
+        assert chunks.join() != blob
+        out = wire.decode(chunks)
+        assert out["w"][0, 0] == 123.0
+
+    def test_chunks_passthrough_for_array_free_payloads(self):
+        chunks = wire.encode_chunks({"cmd": "heartbeat", "power": 2.0})
+        assert wire.decode(chunks) == {"cmd": "heartbeat", "power": 2.0}
+
+    def test_compressed_oob_roundtrip(self):
+        tree = {"w": numpy.zeros(100000, numpy.float32)}
+        blob = wire.encode(tree)
+        assert blob[:1] == wire.ZLIB
+        assert len(blob) < 10000  # zeros compress hard
+        numpy.testing.assert_array_equal(wire.decode(blob)["w"],
+                                         tree["w"])
+
+    def test_legacy_pickle_blobs_still_decode(self):
+        """Blobs from a pre-OOB peer (RAW/ZLIB full pickles) decode."""
+        import zlib
+        tree = {"a": numpy.arange(5), "b": "x"}
+        raw = wire.RAW + pickle.dumps(tree, protocol=4)
+        _assert_tree_equal(wire.decode(raw), tree)
+        packed = wire.ZLIB + zlib.compress(pickle.dumps(tree,
+                                                        protocol=4), 1)
+        _assert_tree_equal(wire.decode(packed), tree)
+
+    @pytest.mark.filterwarnings("ignore::DeprecationWarning")
+    def test_numpy1x_core_alias_skeleton(self):
+        """A numpy-1.x peer pickles arrays through ``numpy.core``; the
+        allowlist accepts both spellings (they are the same two
+        functions)."""
+        arr = numpy.arange(12, dtype=numpy.float32).reshape(3, 4)
+        p4 = pickle.dumps({"w": arr}, protocol=4)
+        old = b"\x8c\x16numpy._core.multiarray"  # SHORT_BINUNICODE 22
+        new = b"\x8c\x15numpy.core.multiarray"   # SHORT_BINUNICODE 21
+        count = p4.count(old)
+        assert count >= 1
+        legacy = p4.replace(old, new)
+        # each rename shortens the proto-4 frame by one byte
+        assert legacy[2:3] == b"\x95"  # FRAME opcode
+        frame_len = struct.unpack("<Q", legacy[3:11])[0] - count
+        legacy = legacy[:3] + struct.pack("<Q", frame_len) + legacy[11:]
+        assert b"numpy.core.multiarray" in legacy
+        out = wire.decode(wire.RAW + legacy)
+        numpy.testing.assert_array_equal(out["w"], arr)
+
+
+class TestOutOfBandSecurity(object):
+    """Raw buffers must not widen the restricted-unpickle surface."""
+
+    def _oob_blob(self, meta, skel, data=b""):
+        meta_b = json.dumps(meta, separators=(",", ":")).encode()
+        return (wire.OOB + wire.OOB_MAGIC +
+                struct.pack("<I", len(meta_b)) + meta_b + skel + data)
+
+    def test_evil_skeleton_rejected(self):
+        import os
+        skel = pickle.dumps(os.system)
+        blob = self._oob_blob({"skel": len(skel), "data": 0,
+                               "leaves": []}, skel)
+        with pytest.raises(wire.UnsafePayloadError, match="system"):
+            wire.decode(blob)
+
+    def test_reduce_gadget_in_skeleton_rejected(self):
+        class Gadget(object):
+            def __reduce__(self):
+                return (print, ("pwned",))
+
+        skel = pickle.dumps({"g": Gadget()})
+        blob = self._oob_blob({"skel": len(skel), "data": 0,
+                               "leaves": []}, skel)
+        with pytest.raises(wire.UnsafePayloadError):
+            wire.decode(blob)
+
+    def test_object_dtype_token_rejected(self):
+        skel = pickle.dumps(wire._Leaf(0), protocol=4)
+        blob = self._oob_blob(
+            {"skel": len(skel), "data": 0,
+             "leaves": [["O", [1], 0, 8]]}, skel, b"\x00" * 64)
+        with pytest.raises(wire.UnsafePayloadError, match="dtype"):
+            wire.decode(blob)
+
+    def test_out_of_bounds_leaf_rejected(self):
+        skel = pickle.dumps(wire._Leaf(0), protocol=4)
+        blob = self._oob_blob(
+            {"skel": len(skel), "data": 0,
+             "leaves": [["<f4", [1 << 20], 0, 4 << 20]]}, skel,
+            b"\x00" * 64)
+        with pytest.raises(wire.UnsafePayloadError, match="bounds"):
+            wire.decode(blob)
+
+    def test_leaf_index_out_of_range_rejected(self):
+        # a skeleton referencing a leaf the table never declared
+        skel = pickle.dumps(wire._Leaf(5), protocol=4)
+        blob = self._oob_blob({"skel": len(skel), "data": 0,
+                               "leaves": []}, skel)
+        with pytest.raises(wire.UnsafePayloadError, match="range"):
+            wire.decode(blob)
+
+    def test_truncated_header_rejected(self):
+        with pytest.raises(wire.UnsafePayloadError):
+            wire.decode(wire.OOB + wire.OOB_MAGIC + b"\x01")
+
+    def test_raw_forbidden_global_still_rejected(self):
+        import os
+        with pytest.raises(wire.UnsafePayloadError, match="system"):
+            wire.decode(wire.RAW + pickle.dumps(os.system))
+
+
+class TestDeltaExchange(object):
+    def _tree(self, w, b, epoch):
+        return {"units": [("gd", {"weights": w, "bias": b}),
+                          ("decision", {"epoch": epoch})],
+                "batches": [{"indices": numpy.arange(10, dtype="i4"),
+                             "size": 10}]}
+
+    def test_full_then_delta_reconstructs(self):
+        w0 = RNG.randn(100, 50).astype("f4")
+        b0 = RNG.randn(50).astype("f4")
+        enc, dec = wire.DeltaEncoder(), wire.DeltaDecoder()
+        first = enc.encode(self._tree(w0, b0, 0))
+        assert first["kind"] == "full"
+        t0 = dec.decode(wire.decode(wire.encode(first, compress=False)))
+        numpy.testing.assert_array_equal(t0["units"][0][1]["weights"],
+                                         w0)
+        w1 = w0 + RNG.randn(*w0.shape).astype("f4") * 0.01
+        second = enc.encode(self._tree(w1, b0, 1))
+        assert second["kind"] == "delta"
+        t1 = dec.decode(wire.decode(wire.encode(second,
+                                                compress=False)))
+        numpy.testing.assert_allclose(t1["units"][0][1]["weights"], w1,
+                                      atol=1e-6)
+        # bias never moved: skipped on the wire, identity on arrival
+        assert enc.leaves_skipped == 1
+        numpy.testing.assert_array_equal(t1["units"][0][1]["bias"], b0)
+        assert t1["units"][1][1]["epoch"] == 1
+
+    def test_master_base_tracks_slave_reconstruction_exactly(self):
+        """No drift: after a lossy bf16 delta push the encoder's base
+        must equal the decoder's reconstruction BIT-EXACTLY, so cast
+        error never accumulates across pushes."""
+        w0 = RNG.randn(80, 40).astype("f4")
+        b0 = RNG.randn(40).astype("f4")
+        enc = wire.DeltaEncoder(dtype="bfloat16")
+        dec = wire.DeltaDecoder()
+        dec.decode(enc.encode(self._tree(w0, b0, 0)))
+        w = w0
+        for step in range(1, 4):
+            w = w + RNG.randn(*w.shape).astype("f4") * 0.01
+            out = dec.decode(enc.encode(self._tree(w, b0, step)))
+            recon = out["units"][0][1]["weights"]
+            path = ("units", 0, 1, "weights")
+            numpy.testing.assert_array_equal(enc._base[path], recon)
+            # one-push quantization bound, not step-count growth
+            assert numpy.abs(recon - w).max() < 1e-3
+
+    def test_bf16_delta_halves_wire_bytes(self):
+        w0 = RNG.randn(256, 256).astype("f4")
+        b0 = RNG.randn(256).astype("f4")
+        enc = wire.DeltaEncoder(dtype="bfloat16")
+        enc.encode(self._tree(w0, b0, 0))
+        w1 = w0 + 0.01
+        delta_msg = enc.encode(self._tree(w1, b0, 1))
+        full = wire.encode_chunks(self._tree(w1, b0, 1)).nbytes
+        delta = wire.encode_chunks(delta_msg).nbytes
+        assert delta < 0.6 * full
+
+    def test_epsilon_skip(self):
+        w0 = RNG.randn(64, 64).astype("f4")
+        b0 = RNG.randn(64).astype("f4")
+        enc = wire.DeltaEncoder(eps=1e-3)
+        dec = wire.DeltaDecoder()
+        dec.decode(enc.encode(self._tree(w0, b0, 0)))
+        tiny = w0 + 1e-5  # under eps: the leaf must not ship
+        out = dec.decode(enc.encode(self._tree(tiny, b0, 1)))
+        assert enc.leaves_skipped == 2  # weights AND bias
+        numpy.testing.assert_array_equal(out["units"][0][1]["weights"],
+                                         w0)
+
+    def test_shape_change_falls_back_to_verbatim(self):
+        enc, dec = wire.DeltaEncoder(), wire.DeltaDecoder()
+        dec.decode(enc.encode({"w": RNG.randn(8, 8).astype("f4")}))
+        new = RNG.randn(3, 5).astype("f4")
+        out = dec.decode(enc.encode({"w": new}))
+        numpy.testing.assert_array_equal(out["w"], new)
+
+    def test_non_delta_messages_pass_through(self):
+        dec = wire.DeltaDecoder()
+        msg = {"plain": 1, "w": RNG.randn(4).astype("f4")}
+        assert dec.decode(msg) is msg
+
+    def test_delta_before_full_rejected(self):
+        dec = wire.DeltaDecoder()
+        with pytest.raises(ValueError, match="full"):
+            dec.decode({wire._D_WRAP: 1, "kind": "delta", "tree": {}})
+
+    def test_marker_shaped_user_dicts_escaped(self):
+        enc, dec = wire.DeltaEncoder(), wire.DeltaDecoder()
+        tree = {"cfg": {"__dkeep__": 1},
+                "w": RNG.randn(16).astype("f4")}
+        out = dec.decode(enc.encode(tree))
+        assert out["cfg"] == {"__dkeep__": 1}
+        out = dec.decode(enc.encode(tree))
+        assert out["cfg"] == {"__dkeep__": 1}
+        numpy.testing.assert_array_equal(out["w"], tree["w"])
+
+    def test_delta_through_full_wire_stack(self):
+        """Delta messages survive the OOB codec end to end (the actual
+        master->slave path: DeltaEncoder -> encode_chunks -> shm bytes
+        -> decode -> DeltaDecoder)."""
+        ml_dtypes = pytest.importorskip("ml_dtypes")
+        w0 = RNG.randn(128, 64).astype("f4")
+        b0 = RNG.randn(64).astype("f4")
+        enc = wire.DeltaEncoder(dtype="bfloat16")
+        dec = wire.DeltaDecoder()
+        blob = wire.encode_chunks(enc.encode(self._tree(w0, b0, 0)))
+        dec.decode(wire.decode(blob.join()))
+        w1 = w0 + RNG.randn(*w0.shape).astype("f4") * 0.01
+        msg = enc.encode(self._tree(w1, b0, 1))
+        # the delta leaf really is bf16 on the wire
+        delta_leaf = msg["tree"]["units"][0][1]["weights"]
+        assert delta_leaf[wire._D_ADD].dtype == numpy.dtype(
+            ml_dtypes.bfloat16)
+        out = dec.decode(wire.decode(
+            wire.encode_chunks(msg).join()))
+        assert numpy.abs(out["units"][0][1]["weights"] - w1).max() \
+            < 1e-3

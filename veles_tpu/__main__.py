@@ -166,6 +166,8 @@ class Main(Logger):
             "secret_file": getattr(args, "secret_file", None),
             "max_frame_mb": getattr(args, "max_frame_mb", None),
             "interactive": getattr(args, "interactive", False),
+            "exchange_dtype": getattr(args, "exchange_dtype", "none"),
+            "exchange_eps": getattr(args, "exchange_eps", 0.0),
         }
         if args.listen_address:
             kwargs["listen_address"] = args.listen_address
@@ -208,6 +210,13 @@ class Main(Logger):
             self._run_and_report()
 
     def _run_and_report(self):
+        if self._ran:
+            # -i console: a second main() would retrain from the
+            # already-trained weights and silently overwrite the result
+            # file — warn and keep the existing results
+            self.warning("main() already ran in this session; skipping "
+                         "(results were already written)")
+            return
         self._ran = True  # even on failure: exiting must NOT retrain
         try:
             self._run_and_report_inner()

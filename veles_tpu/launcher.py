@@ -66,7 +66,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         "job_timeout", "heartbeat_timeout", "max_idle",
         "nodes", "respawn", "slave_command", "eager", "segment_size",
         "pipeline", "secret", "secret_file", "max_frame_mb",
-        "interactive",
+        "interactive", "exchange_dtype", "exchange_eps",
     ])
 
     def __init__(self, **kwargs):
@@ -103,6 +103,17 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         #: slave: prefetch the next job while computing (async SGD,
         #: one job of weight staleness); False = strict lockstep
         self.pipeline = kwargs.get("pipeline", True)
+        #: master->slave parameter-delta exchange: None/"none" = full
+        #: weights every job (bit-compatible with the strict protocol);
+        #: "float32" = per-leaf deltas with a dirty/epsilon skip;
+        #: "bfloat16" = deltas cast to bf16, halving exchange bytes
+        #: (bounded one-push quantization error; async-SGD class, like
+        #: --pipeline's staleness)
+        dtype = kwargs.get("exchange_dtype")
+        self.exchange_dtype = None if dtype in (None, "none") else dtype
+        #: with delta exchange: skip leaves whose max |delta| is <= eps
+        #: (0.0 = skip only exactly-unchanged leaves)
+        self.exchange_eps = float(kwargs.get("exchange_eps", 0.0))
         #: shared secret for the coordinator's mutual HMAC handshake:
         #: explicit kwarg > --secret-file > VELES_TPU_SECRET env
         self.secret = kwargs.get("secret")
@@ -195,6 +206,19 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             help="slave: strict request-reply instead of prefetching "
                  "the next job while computing (exact sequential SGD, "
                  "no overlap)")
+        parser.add_argument(
+            "--exchange-dtype", dest="exchange_dtype", default="none",
+            choices=["none", "float32", "bfloat16"],
+            help="master: after the first full weight push, send "
+                 "per-leaf parameter DELTAS to each slave (skipping "
+                 "unchanged leaves); bfloat16 additionally casts the "
+                 "deltas, halving master->slave exchange bytes")
+        parser.add_argument(
+            "--exchange-eps", dest="exchange_eps", type=float,
+            default=0.0,
+            help="with --exchange-dtype: also skip leaves whose "
+                 "largest delta magnitude is <= EPS (default 0: skip "
+                 "only exactly-unchanged leaves)")
         return parser
 
     # -- mode --------------------------------------------------------------
@@ -303,9 +327,26 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                 raise NoMoreJobsError()
             if data is None:
                 return None
-            # same-host slaves get raw pickles through shm; remote
-            # slaves get zlib-compressed binary frames
-            return {"blob": _encode(data, compress=not slave.sharedio)}
+            if self.exchange_dtype is not None:
+                # per-slave delta stream: first push full, then deltas
+                # (state is connection-scoped on both ends, so a
+                # reconnected slave restarts with a full push)
+                enc = getattr(slave, "delta_encoder", None)
+                if enc is None:
+                    enc = wire.DeltaEncoder(
+                        dtype=None if self.exchange_dtype == "float32"
+                        else self.exchange_dtype, eps=self.exchange_eps)
+                    slave.delta_encoder = enc
+                data = enc.encode(data)
+            if slave.sharedio:
+                # same-host: out-of-band array framing as scatter/gather
+                # chunks — Protocol.send memcpys each array straight
+                # into the shared segment, no pickle byte-string ever
+                # materializes (docs/PERF.md r5: that pickle pass alone
+                # cost 1.8 s at AlexNet-227 scale)
+                return {"blob": wire.encode_chunks(data)}
+            # remote slaves get zlib-compressed binary frames
+            return {"blob": _encode(data, compress=True)}
 
         def result_sink(data, slave):
             workflow.apply_data_from_slave(_decode(data["blob"]), slave)
@@ -540,15 +581,21 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         workflow = self.workflow
         from veles_tpu.train.segment import SegmentExecutor
         executor = SegmentExecutor(workflow, eager=self.eager)
-        compress = not self._client.proto._shm_tx
+        sharedio = self._client.proto._shm_tx
+        # reconstructs --exchange-dtype delta pushes against the last
+        # applied payload; plain full pushes pass through untouched
+        delta = wire.DeltaDecoder()
 
         def handler(job):
-            payload = _decode(job["blob"])
+            payload = delta.decode(_decode(job["blob"]))
             if isinstance(payload, dict) and "batches" in payload:
                 update = executor.execute(payload)
             else:
                 update = workflow.do_job(payload)
-            return {"blob": _encode(update, compress=compress)}
+            if sharedio:
+                # zero-copy out-of-band framing straight into shm
+                return {"blob": wire.encode_chunks(update)}
+            return {"blob": _encode(update, compress=True)}
 
         self._client.serve_forever(handler, max_idle=self.max_idle)
 

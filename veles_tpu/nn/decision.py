@@ -159,14 +159,48 @@ class DecisionBase(Unit, IResultProvider):
         if bucket[klass]["samples"] == self.class_lengths[klass]:
             self._on_class_finished(klass, epoch=epoch, stats_set=bucket)
         if sum(b["samples"] for b in bucket) == sum(self.class_lengths):
-            self._on_epoch_finished(epoch=epoch, stats_set=bucket)
+            # Epochs close STRICTLY IN ORDER. With the 1-epoch run-ahead
+            # window, a fast slave can complete ALL of epoch e+1 while a
+            # slow sibling still holds epoch e's jobs in its pipeline —
+            # closing e+1 first would let max_epochs stop the run with
+            # epoch e permanently open (epoch_history [.., e-1, e+1]).
+            # A complete-but-out-of-order bucket is therefore parked
+            # until every older epoch has closed.
             buckets.pop(epoch, None)
+            done = getattr(self, "_complete_epochs_", None)
+            if done is None:
+                done = self._complete_epochs_ = {}
+            done[epoch] = bucket
+            nxt = getattr(self, "_next_close_epoch_", None)
+            if nxt is None:
+                # snapshot resume: continue after the last closed epoch
+                nxt = max((h["epoch"] for h in self.epoch_history),
+                          default=-1) + 1
+            while nxt in done:
+                self._on_epoch_finished(epoch=nxt,
+                                        stats_set=done.pop(nxt))
+                nxt += 1
+                if getattr(self, "_stop_epoch_", None) is not None:
+                    done.clear()  # run-ahead epochs are cancelled
+            self._next_close_epoch_ = nxt
         # bound run-ahead: with asymmetric slave speeds the loader would
         # otherwise serve arbitrarily many epochs past the oldest still
         # open one, training epochs the stop decision may cancel.
         # Withholding data (has_data_for_slave=False) idles job requests
         # until the laggard's updates close the old epoch.
-        min_open = min(buckets) if buckets else None
+        # the oldest OPEN epoch: once in-order closing has begun,
+        # _next_close_epoch_ is it by construction (every older epoch
+        # closed; a complete-but-parked younger epoch is NOT open but
+        # must not mask an older one that has produced no update yet —
+        # min(buckets) alone would, and the run-ahead window would
+        # creep one epoch per parked bucket)
+        nxt = getattr(self, "_next_close_epoch_", None)
+        if nxt is not None:
+            min_open = nxt
+        elif buckets:
+            min_open = min(buckets)
+        else:
+            min_open = None
         # ... but never throttle while requeued minibatches (from a dead
         # slave) are waiting: they belong to the oldest open epoch, and
         # serving them is the only way that epoch can ever close.

@@ -32,6 +32,12 @@ import uuid
 
 from veles_tpu import prng
 from veles_tpu.logger import Logger
+from veles_tpu.parallel import wire
+
+
+def _blob_len(data):
+    """bytes or :class:`wire.Chunks` -> payload length."""
+    return data.nbytes if isinstance(data, wire.Chunks) else len(data)
 
 
 class NoMoreJobsError(Exception):
@@ -96,6 +102,7 @@ class Protocol(object):
         self._seg_turn = 0
         self.shm_sends = 0
         self.shm_reads = 0
+        self.shm_regrows = 0
 
     # -- sharedio ----------------------------------------------------------
 
@@ -119,8 +126,13 @@ class Protocol(object):
         if seg is not None:  # regrow
             seg.close()
             seg.unlink()
+            self.shm_regrows += 1
+        # 25% slack so payloads whose size oscillates between cycles
+        # (delta pushes vs full pushes, varying batch counts) reuse the
+        # segment instead of regrowing every other send
         seg = shared_memory.SharedMemory(
-            create=True, size=max(size, self.SHM_THRESHOLD))
+            create=True,
+            size=max(size + (size >> 2), self.SHM_THRESHOLD))
         self._segments[turn] = seg
         return seg
 
@@ -138,8 +150,13 @@ class Protocol(object):
         (``{"__bin__": int}`` alone, or containing ``__shm__`` /
         ``__esc__``) is wrapped in ``{"__esc__": ...}`` so the receiver
         never mistakes payload data for a frame/segment reference."""
-        if isinstance(value, bytes):
-            if self._shm_tx and len(value) >= self.SHM_THRESHOLD:
+        if isinstance(value, (bytes, wire.Chunks)):
+            # Chunks (scatter/gather array payloads, wire.encode_chunks)
+            # behave exactly like bytes on the wire: the shm path
+            # memcpys each part straight into the segment and the frame
+            # path writes them back-to-back under one length prefix —
+            # either way the peer receives one contiguous blob
+            if self._shm_tx and _blob_len(value) >= self.SHM_THRESHOLD:
                 ref = {}
                 shm_items.append((ref, value, "b"))
                 return ref
@@ -182,19 +199,35 @@ class Protocol(object):
             shm_items = []
             message = self._pack(message, bins, shm_items)
             if shm_items:
-                seg = self._segment_for(
-                    sum(len(data) for _, data, _ in shm_items))
+                # 64-byte-align every blob so OOB array views decoded
+                # straight from the segment land cacheline-aligned
+                total = 0
+                for _, data, _ in shm_items:
+                    total += (-total) % 64 + _blob_len(data)
+                seg = self._segment_for(total)
                 offset = 0
                 for ref, data, kind in shm_items:
-                    seg.buf[offset:offset + len(data)] = data
+                    offset += (-offset) % 64
+                    size = _blob_len(data)
+                    if isinstance(data, wire.Chunks):
+                        pos = offset
+                        for part in data.parts:
+                            seg.buf[pos:pos + len(part)] = part
+                            pos += len(part)
+                    else:
+                        seg.buf[offset:offset + size] = data
                     ref.update({"__shm__": seg.name, "off": offset,
-                                "size": len(data), "kind": kind})
-                    offset += len(data)
+                                "size": size, "kind": kind})
+                    offset += size
                     self.shm_sends += 1
             self._file.write((json.dumps(message) + "\n").encode())
             for data in bins:
-                self._file.write(len(data).to_bytes(8, "big"))
-                self._file.write(data)
+                self._file.write(_blob_len(data).to_bytes(8, "big"))
+                if isinstance(data, wire.Chunks):
+                    for part in data.parts:
+                        self._file.write(part)
+                else:
+                    self._file.write(data)
             self._file.flush()
 
     # -- receive path ------------------------------------------------------

@@ -145,7 +145,11 @@ def slave_command_from_argv(argv, master_address):
     (the reference's ``filter_argv`` idea, ``launcher.py:75-96``):
     strip master-only flags, add ``-m host:port``."""
     import sys
-    drop_with_value = {"-l", "--listen", "-n", "--nodes", "-d", "--device"}
+    drop_with_value = {"-l", "--listen", "-n", "--nodes", "-d", "--device",
+                       # master-side exchange policy: the slave's
+                       # DeltaDecoder auto-detects delta pushes, so the
+                       # flags would only be parsed and ignored
+                       "--exchange-dtype", "--exchange-eps"}
     drop_bare = {"--respawn", "--web-status"}
     out = [sys.executable, "-m", "veles_tpu"]
     i = 0
