@@ -192,7 +192,7 @@ def main():
 
     import bench  # repo-root bench.py: shared matmul-peak measurement
 
-    names = sys.argv[1:] or list(CONFIGS) + ["som"]
+    names = sys.argv[1:] or list(CONFIGS) + ["som", "serving"]
     set_policy(PRECISION)
     peak = bench.measured_matmul_peak_tflops()
     print("chip matmul peak: %.1f TF/s, policy=%s, window>=%.0fs"
@@ -203,6 +203,17 @@ def main():
     print("|---|---|---|---|---|")
     for name in names:
         t0 = time.time()
+        if name == "serving":
+            # the serving engine has its own metric shape (QPS vs the
+            # legacy path, not samples/s vs MFU) — delegate and print
+            # its row verbatim after the table
+            import bench_serving
+            result = bench_serving.run(quick=True)
+            print(bench_serving.markdown_row(result), flush=True)
+            print("serving: %.1fx in %.0fs total"
+                  % (result["speedup"], time.time() - t0),
+                  file=sys.stderr)
+            continue
         if name == "som":
             rate, flops, label = bench_som()
         else:

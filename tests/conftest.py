@@ -43,6 +43,12 @@ import pytest  # noqa: E402
 _TEST_TIMEOUT = float(os.environ.get("VELES_TEST_TIMEOUT", 600))
 
 
+def pytest_configure(config):
+    # the tier-1 job runs -m 'not slow'; long soaks opt out with it
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+
+
 @pytest.fixture(autouse=True)
 def _test_watchdog():
     if _TEST_TIMEOUT <= 0:

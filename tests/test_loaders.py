@@ -167,6 +167,63 @@ def test_interactive_loader_feeds():
     assert loader.minibatch_class == TEST
 
 
+def test_queue_fed_loader_batches_queued_samples():
+    """minibatch_size > 1: one fill drains everything already queued
+    (up to the cap), pads the rest with zeros and reports the valid
+    count in minibatch_size."""
+    from veles_tpu.loader.interactive import QueueFedLoader
+    loader = QueueFedLoader(DummyWorkflow(), sample_shape=(3,),
+                            minibatch_size=4)
+    _init_loader(loader)
+    assert loader.minibatch_data.mem.shape == (4, 3)
+    # dirty the buffer so the zero-padding assertion is meaningful
+    loader.minibatch_data.mem[...] = 7.0
+    for i in range(3):
+        loader.feed([float(i)] * 3)
+    loader.run()
+    assert loader.minibatch_size == 3
+    assert loader.minibatch_class == TEST
+    for i in range(3):
+        assert numpy.allclose(loader.minibatch_data.mem[i], float(i))
+    assert numpy.allclose(loader.minibatch_data.mem[3], 0.0)
+
+
+def test_queue_fed_loader_caps_at_minibatch_size():
+    from veles_tpu.loader.interactive import QueueFedLoader
+    loader = QueueFedLoader(DummyWorkflow(), sample_shape=(2,),
+                            minibatch_size=2)
+    _init_loader(loader)
+    for i in range(5):
+        loader.feed([float(i)] * 2)
+    loader.run()
+    assert loader.minibatch_size == 2
+    assert numpy.allclose(loader.minibatch_data.mem[0], 0.0)
+    assert numpy.allclose(loader.minibatch_data.mem[1], 1.0)
+    loader.run()  # leftovers come in the next fill, in order
+    assert loader.minibatch_size == 2
+    assert numpy.allclose(loader.minibatch_data.mem[0], 2.0)
+    assert numpy.allclose(loader.minibatch_data.mem[1], 3.0)
+
+
+def test_queue_fed_loader_eof_mid_drain_serves_batch_then_stops():
+    """EOF discovered while draining terminates AFTER the collected
+    samples are served — fed requests are never dropped."""
+    from veles_tpu.loader.interactive import QueueFedLoader
+    wf = DummyWorkflow()
+    loader = QueueFedLoader(wf, sample_shape=(2,), minibatch_size=4)
+    _init_loader(loader)
+    loader.feed([1.0, 1.0])
+    loader.feed([2.0, 2.0])
+    loader.finish()
+    loader.run()
+    assert loader.minibatch_size == 2
+    assert numpy.allclose(loader.minibatch_data.mem[1], 2.0)
+    stopped = []
+    wf.stop = lambda: stopped.append(True)
+    loader.run()  # the requeued EOF now stops the workflow
+    assert stopped and loader.minibatch_size == 0
+
+
 def test_socket_fed_loader():
     from veles_tpu.zmq_loader import SocketFedLoader
     loader = SocketFedLoader(DummyWorkflow(), sample_shape=(2,))

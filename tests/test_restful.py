@@ -7,6 +7,7 @@ POST samples at it."""
 import base64
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -189,6 +190,128 @@ def test_base64_type_must_be_string(service):
     assert status == 400 and "error" in reply
     status, reply = _post(api.address, {"input": {"a": 1}, "codec": "list"})
     assert status == 400 and "error" in reply
+
+
+def test_request_id_echo(service):
+    """Concurrent clients correlate responses by their own "id"."""
+    wf, api, loader = service
+    status, reply = _post(api.address, {"input": [1.0, 2.0, 3.0, 4.0],
+                                        "codec": "list", "id": "abc-7"})
+    assert status == 200 and reply["id"] == "abc-7"
+    # errors echo it too (after JSON parse succeeds)
+    status, reply = _post(api.address, {"codec": "list", "id": 99})
+    assert status == 400 and reply["id"] == 99
+    # requests without an id get responses without one
+    status, reply = _post(api.address, {"input": [0, 0, 0, 0],
+                                        "codec": "list"})
+    assert status == 200 and "id" not in reply
+
+
+def test_overload_fails_fast_with_503():
+    """A saturated workflow sheds load with 503 + Retry-After instead
+    of parking every HTTP thread for response_timeout seconds."""
+    import http.client
+    prng.get().seed(13)
+    wf = AcceleratedWorkflow(DummyLauncher())
+    loader = RestfulLoader(wf, sample_shape=(4,), feed_timeout=30)
+    fwd = All2AllSoftmax(wf, output_sample_shape=3, name="fc")
+    fwd.link_from(loader)
+    fwd.link_attrs(loader, ("input", "minibatch_data"))
+    api = RESTfulAPI(wf, port=0, response_timeout=3, max_pending=1)
+    api.link_from(fwd)
+    api.link_attrs(fwd, ("input", "output"))
+    api.feed = loader.feed
+    wf.initialize(device=Device(backend="cpu"))
+    # the workflow is deliberately NOT running: the first request
+    # occupies the single pending slot until its timeout
+    first_status = []
+
+    def first():
+        first_status.append(_post(api.address,
+                                  {"input": [0, 0, 0, 0],
+                                   "codec": "list"})[0])
+
+    t = threading.Thread(target=first)
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not api._pending_:
+        time.sleep(0.01)
+    assert api._pending_, "first request never became pending"
+    start = time.time()
+    conn = http.client.HTTPConnection("127.0.0.1", api.address[1],
+                                      timeout=10)
+    try:
+        conn.request("POST", "/api",
+                     body=json.dumps({"input": [0, 0, 0, 0],
+                                      "codec": "list", "id": "shed"}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+        assert body["id"] == "shed"
+        assert time.time() - start < 2.0  # immediate, not blocked
+    finally:
+        conn.close()
+    t.join(timeout=10)
+    assert first_status == [500]  # the occupant timed out as configured
+    api.stop()
+
+
+def test_batched_service_answers_all_requests_consistently():
+    """minibatch_size > 1 end to end: concurrent requests coalesce into
+    one forward and every client gets the same answer it would have
+    gotten alone."""
+    prng.get().seed(17)
+    wf = AcceleratedWorkflow(DummyLauncher())
+    repeater = Repeater(wf)
+    repeater.link_from(wf.start_point)
+    loader = RestfulLoader(wf, sample_shape=(4,), feed_timeout=30,
+                           minibatch_size=4)
+    loader.link_from(repeater)
+    fwd = All2AllSoftmax(wf, output_sample_shape=3, name="fc")
+    fwd.link_from(loader)
+    fwd.link_attrs(loader, ("input", "minibatch_data"))
+    api = RESTfulAPI(wf, port=0, response_timeout=10)
+    api.link_from(fwd)
+    api.link_attrs(fwd, ("input", "output"))
+    api.link_attrs(loader, ("batch_size", "minibatch_size"))
+    api.feed = loader.feed
+    repeater.link_from(api)
+    wf.initialize(device=Device(backend="cpu"))
+    assert loader.minibatch_data.mem.shape == (4, 4)
+    thread = threading.Thread(target=wf.run, daemon=True)
+    thread.start()
+    try:
+        samples = [numpy.eye(4, dtype=numpy.float32)[i % 4] * (i + 1)
+                   for i in range(8)]
+        # sequential ground truth, one request at a time
+        expected = [_post(api.address, {"input": s.tolist(),
+                                        "codec": "list"})[1]["result"]
+                    for s in samples]
+        results = {}
+
+        def ask(i):
+            results[i] = _post(api.address,
+                               {"input": samples[i].tolist(),
+                                "codec": "list", "id": i})
+
+        threads = [threading.Thread(target=ask, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(results) == 8
+        for i, (status, reply) in results.items():
+            assert status == 200 and reply["id"] == i
+            numpy.testing.assert_allclose(reply["result"], expected[i],
+                                          rtol=1e-5, atol=1e-6)
+    finally:
+        loader.finish()
+        thread.join(timeout=20)
+        api.stop()
+        assert not thread.is_alive()
 
 
 def test_port_and_path_validation():

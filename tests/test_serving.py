@@ -1,0 +1,336 @@
+"""Serving engine internals: model store, replicas, dynamic batcher.
+
+The snapshot → serve round-trip is the headline test: train a tiny
+MNIST FC model a few steps, snapshot it with the real Snapshotter
+machinery, load the snapshot through ``serving.model_store``, and
+assert the served forward matches the live workflow forward
+bit-for-bit. The export-package path is held to allclose (it rebuilds
+the math from stored weights instead of reusing the units' apply).
+"""
+
+import os
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.models.mnist import MnistWorkflow
+from veles_tpu.serving.engine import DynamicBatcher, EngineOverloaded
+from veles_tpu.serving.metrics import ServingMetrics
+from veles_tpu.serving.model_store import (ModelLoadError, ModelStore,
+                                           ServeableModel)
+from veles_tpu.serving.replica import (Replica, ReplicaPool, bucket_for,
+                                       buckets_upto)
+
+
+class tiny_digits(object):
+    """Picklable provider (loaders ride inside snapshots)."""
+
+    def __call__(self):
+        rng = numpy.random.RandomState(7)
+        return (rng.rand(60, 12, 12).astype(numpy.float32),
+                rng.randint(0, 10, 60).astype(numpy.int32),
+                rng.rand(20, 12, 12).astype(numpy.float32),
+                rng.randint(0, 10, 20).astype(numpy.int32))
+
+
+def _trained_workflow(max_epochs=2):
+    prng.get().seed(11)
+    prng.get("loader").seed(12)
+    wf = MnistWorkflow(DummyLauncher(), provider=tiny_digits(),
+                      layers=(16,), minibatch_size=20,
+                      max_epochs=max_epochs)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    return wf
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return _trained_workflow()
+
+
+def _live_forward(wf, x):
+    """The live workflow's own forward math over a host batch."""
+    import jax
+    y = x
+    for fwd in wf.forwards:
+        params = {k: numpy.asarray(v.map_read())
+                  for k, v in fwd.param_arrays().items()}
+        y = numpy.asarray(jax.jit(fwd.apply)(params, y))
+    return y
+
+
+# -- bucketing -------------------------------------------------------------
+
+
+def test_bucket_for():
+    assert bucket_for(1, 64) == 1
+    assert bucket_for(3, 64) == 4
+    assert bucket_for(33, 64) == 64
+    assert bucket_for(200, 64) == 64
+    assert buckets_upto(8) == [1, 2, 4, 8]
+    assert buckets_upto(48) == [1, 2, 4, 8, 16, 32, 48]
+
+
+# -- model store -----------------------------------------------------------
+
+
+def test_from_workflow_matches_live_forward(trained):
+    model = ServeableModel.from_workflow(trained, name="mnist")
+    x = numpy.random.RandomState(0).rand(6, 144).astype(numpy.float32)
+    numpy.testing.assert_array_equal(model(x), _live_forward(trained, x))
+    assert model.sample_shape == (144,)
+
+
+def test_snapshot_to_serve_roundtrip(trained, tmp_path):
+    """Snapshot with the real Snapshotter → serve → identical outputs."""
+    from veles_tpu.snapshotter import SnapshotterToFile
+    snap = SnapshotterToFile(trained, directory=str(tmp_path),
+                             prefix="srv", interval=1, time_interval=0)
+    snap.initialize()
+    snap.time = 0  # defeat the time gate
+    snap.export()
+    assert snap.destination and os.path.exists(snap.destination)
+
+    store = ModelStore()
+    model = store.load(snap.destination, name="mnist")
+    x = numpy.random.RandomState(1).rand(5, 144).astype(numpy.float32)
+    numpy.testing.assert_array_equal(model(x), _live_forward(trained, x))
+    # a probability head stays a probability head through the trip
+    numpy.testing.assert_allclose(model(x).sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_store_load_from_snapshot_directory(trained, tmp_path):
+    """Pointing the store at the snapshot DIRECTORY picks the newest
+    snapshot (the _current symlink SnapshotterToFile maintains)."""
+    from veles_tpu.snapshotter import SnapshotterToFile
+    snap = SnapshotterToFile(trained, directory=str(tmp_path),
+                             prefix="srv", interval=1, time_interval=0)
+    snap.initialize()
+    snap.time = 0
+    snap.export()
+    model = ModelStore().load(str(tmp_path), name="mnist")
+    x = numpy.random.RandomState(2).rand(3, 144).astype(numpy.float32)
+    numpy.testing.assert_array_equal(model(x), _live_forward(trained, x))
+
+
+def test_package_to_serve_roundtrip(trained, tmp_path):
+    from veles_tpu.export.package import export_workflow
+    pkg = export_workflow(trained, str(tmp_path / "pkg"))
+    model = ServeableModel.from_package(pkg, name="mnist")
+    x = numpy.random.RandomState(3).rand(4, 144).astype(numpy.float32)
+    numpy.testing.assert_allclose(model(x), _live_forward(trained, x),
+                                  rtol=1e-5, atol=1e-6)
+    assert model.sample_shape == (144,)
+    # tar packages load too
+    tar = export_workflow(trained, str(tmp_path / "pkg.tar"))
+    model2 = ModelStore().load(tar, name="mnist-tar")
+    numpy.testing.assert_allclose(model2(x), model(x), rtol=1e-6)
+
+
+def test_store_versioning_and_pinning(trained):
+    store = ModelStore()
+    v1 = store.add(ServeableModel.from_workflow(trained, name="m"))
+    v2 = store.add(ServeableModel.from_workflow(trained, name="m"))
+    assert (v1.version, v2.version) == (1, 2)
+    assert store.get("m").version == 2          # newest by default
+    store.pin("m", 1)
+    assert store.get("m").version == 1          # pin wins
+    assert store.get("m", version=2).version == 2  # explicit beats pin
+    store.unpin("m")
+    assert store.get("m").version == 2
+    with pytest.raises(KeyError):
+        store.get("m", version=9)
+    with pytest.raises(KeyError):
+        store.pin("m", 9)
+    assert store.versions("m") == [1, 2]
+    # unnamed get() needs exactly one model in the store
+    assert store.get().name == "m"
+    store.add(ServeableModel.from_workflow(trained, name="other"))
+    with pytest.raises(KeyError):
+        store.get()
+
+
+def test_unsupported_package_unit_is_clear_error(tmp_path):
+    import json
+    pkg = tmp_path / "bad"
+    pkg.mkdir()
+    (pkg / "contents.json").write_text(json.dumps({
+        "workflow": {"name": "x", "units": [
+            {"class": {"name": "MysteryUnit"}, "data": {}}]},
+        "input_shape": [1, 4]}))
+    with pytest.raises(ModelLoadError):
+        ServeableModel.from_package(str(pkg))
+
+
+# -- replicas --------------------------------------------------------------
+
+
+def test_replica_pads_to_bucket_and_unpads(trained):
+    model = ServeableModel.from_workflow(trained, name="m")
+    replica = Replica(model, max_batch_size=8, warm=False)
+    try:
+        x = numpy.random.RandomState(4).rand(3, 144).astype(numpy.float32)
+        out, bucket = replica.infer(x)
+        assert bucket == 4 and out.shape == (3, 10)
+        numpy.testing.assert_array_equal(out, model(x))
+    finally:
+        replica.stop()
+
+
+def test_pool_spreads_load_and_counts(trained):
+    model = ServeableModel.from_workflow(trained, name="m")
+    pool = ReplicaPool(model, n_replicas=2, max_batch_size=4, warm=False)
+    try:
+        done = threading.Event()
+        results = []
+
+        def on_done(out, bucket, err):
+            results.append((out, err))
+            if len(results) == 6:
+                done.set()
+
+        x = numpy.ones((2, 144), numpy.float32)
+        for _ in range(6):
+            pool.submit(x, on_done)
+        assert done.wait(30)
+        assert all(err is None for _, err in results)
+        stats = pool.stats()
+        assert sum(s["batches"] for s in stats) == 6
+        # round-robin tie-breaking: both replicas worked
+        assert all(s["batches"] > 0 for s in stats)
+    finally:
+        pool.stop()
+
+
+def test_swapping_replica_looks_busy_to_dispatch(trained):
+    """A queued swap charges SWAP_LOAD: pick() must not route new
+    batches behind a drain + re-warm while another replica is idle."""
+    model = ServeableModel.from_workflow(trained, name="m")
+    slow = _SlowModel(model, delay=0.3)
+    pool = ReplicaPool(slow, n_replicas=2, max_batch_size=4, warm=False)
+    try:
+        done = threading.Event()
+        # occupy replica picked first, then queue a swap behind it
+        busy = pool.pick()
+        busy.submit(numpy.ones((1, 144), numpy.float32),
+                    lambda *a: done.set())
+        busy.swap(model)
+        assert busy.load >= Replica.SWAP_LOAD
+        assert not pool.any_idle() or pool.pick() is not busy
+        # dispatch now avoids the swapping replica
+        assert pool.pick() is not busy
+        assert done.wait(30)
+    finally:
+        pool.stop()
+
+
+def test_pool_hot_swap_drains_and_promotes(trained):
+    model1 = ServeableModel.from_workflow(trained, name="m", version=1)
+    # v2: same topology, perturbed weights — outputs must change
+    model2 = ServeableModel.from_workflow(trained, name="m", version=2)
+    model2.layers = [(fn, {k: v + 0.5 for k, v in params.items()})
+                     for fn, params in model2.layers]
+    pool = ReplicaPool(model1, n_replicas=2, max_batch_size=4, warm=False)
+    try:
+        x = numpy.random.RandomState(5).rand(2, 144).astype(numpy.float32)
+        before = model1(x)
+        pool.swap(model2)
+        assert all(r.model.version == 2 for r in pool.replicas)
+        got = []
+        done = threading.Event()
+        pool.submit(x, lambda out, b, e: (got.append(out), done.set()))
+        assert done.wait(30)
+        assert not numpy.allclose(got[0], before)
+        numpy.testing.assert_array_equal(got[0], model2(x))
+    finally:
+        pool.stop()
+
+
+# -- dynamic batcher -------------------------------------------------------
+
+
+def test_batcher_coalesces_concurrent_requests(trained):
+    model = ServeableModel.from_workflow(trained, name="m")
+    metrics = ServingMetrics()
+    pool = ReplicaPool(model, n_replicas=1, max_batch_size=16, warm=False)
+    batcher = DynamicBatcher(pool, batch_timeout_ms=50, max_queue=64,
+                             metrics=metrics)
+    try:
+        xs = numpy.random.RandomState(6).rand(12, 144).astype(
+            numpy.float32)
+        futures = [batcher.submit(x) for x in xs]
+        results = numpy.stack([f.result(timeout=30) for f in futures])
+        numpy.testing.assert_array_equal(results, model(xs))
+        snap = metrics.snapshot()
+        assert snap["batches"]["rows"] == 12
+        # the 50ms window coalesced them into far fewer forwards
+        assert snap["batches"]["count"] < 12
+        assert snap["batches"]["mean_size"] > 1
+    finally:
+        batcher.stop()
+        pool.stop()
+
+
+def test_batcher_validates_sample_shape(trained):
+    model = ServeableModel.from_workflow(trained, name="m")
+    pool = ReplicaPool(model, n_replicas=1, max_batch_size=4, warm=False)
+    batcher = DynamicBatcher(pool, max_queue=4)
+    try:
+        with pytest.raises(ValueError):
+            batcher.submit(numpy.ones(7, numpy.float32))
+        # flat-but-reshapeable inputs are accepted (12x12 image → 144)
+        fut = batcher.submit(numpy.ones((12, 12), numpy.float32))
+        assert fut.result(timeout=30).shape == (10,)
+    finally:
+        batcher.stop()
+        pool.stop()
+
+
+class _SlowModel(ServeableModel):
+    """Each forward sleeps host-side so the queue can back up."""
+
+    def __init__(self, base, delay=0.2):
+        super(_SlowModel, self).__init__(base.layers, base.sample_shape,
+                                         name=base.name)
+        self._delay = delay
+
+    def forward_fn(self):
+        inner = super(_SlowModel, self).forward_fn()
+
+        def forward(x):
+            time.sleep(self._delay)
+            return inner(x)
+
+        return forward
+
+
+def test_batcher_overload_sheds_instead_of_blocking(trained):
+    slow = _SlowModel(ServeableModel.from_workflow(trained, name="m"),
+                      delay=0.3)
+    pool = ReplicaPool(slow, n_replicas=1, max_batch_size=1, warm=False)
+    batcher = DynamicBatcher(pool, batch_timeout_ms=0, max_queue=2)
+    try:
+        x = numpy.ones(144, numpy.float32)
+        admitted = []
+        start = time.time()
+        rejections = 0
+        for _ in range(12):
+            try:
+                admitted.append(batcher.submit(x))
+            except EngineOverloaded as e:
+                rejections += 1
+                assert e.retry_after >= 1
+        elapsed = time.time() - start
+        assert rejections > 0                    # queue bound enforced
+        assert elapsed < 2.0                     # fail-fast, no blocking
+        for fut in admitted:                     # admitted work completes
+            assert fut.result(timeout=30).shape == (10,)
+    finally:
+        batcher.stop()
+        pool.stop()

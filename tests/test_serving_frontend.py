@@ -1,0 +1,349 @@
+"""HTTP frontend of the serving engine: contract parity with
+``restful_api``, the batch endpoint, admission control (503 +
+Retry-After), metrics, hot-swap, and the web_status integration."""
+
+import base64
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.models.mnist import MnistWorkflow
+from veles_tpu.serving.frontend import ServingFrontend
+from veles_tpu.serving.model_store import ServeableModel
+
+
+class tiny_digits(object):
+    def __call__(self):
+        rng = numpy.random.RandomState(7)
+        return (rng.rand(60, 12, 12).astype(numpy.float32),
+                rng.randint(0, 10, 60).astype(numpy.int32),
+                rng.rand(20, 12, 12).astype(numpy.float32),
+                rng.randint(0, 10, 20).astype(numpy.int32))
+
+
+@pytest.fixture(scope="module")
+def model():
+    prng.get().seed(21)
+    prng.get("loader").seed(22)
+    wf = MnistWorkflow(DummyLauncher(), provider=tiny_digits(),
+                       layers=(16,), minibatch_size=20, max_epochs=1)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    return ServeableModel.from_workflow(wf, name="mnist")
+
+
+@pytest.fixture
+def frontend(model):
+    fe = ServingFrontend(model, port=0, replicas=2, max_batch_size=8,
+                         batch_timeout_ms=3, max_queue=64,
+                         response_timeout=20, warm=False).start()
+    try:
+        yield fe
+    finally:
+        fe.stop()
+
+
+def _post(port, payload, path="/api", content_type="application/json"):
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d%s" % (port, path),
+        data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": content_type}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=20) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, path), timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_single_request_contract(frontend, model):
+    x = numpy.random.RandomState(0).rand(144).astype(numpy.float32)
+    status, reply, _ = _post(frontend.port,
+                             {"input": x.tolist(), "codec": "list",
+                              "id": "req-1"})
+    assert status == 200
+    assert reply["id"] == "req-1"
+    numpy.testing.assert_allclose(
+        reply["result"], model(x[None])[0], rtol=1e-5)
+    # base64 codec matches list codec
+    status, via_b64, _ = _post(frontend.port, {
+        "input": base64.b64encode(x.tobytes()).decode(),
+        "codec": "base64", "shape": [144], "type": "float32"})
+    assert status == 200
+    numpy.testing.assert_allclose(via_b64["result"], reply["result"],
+                                  rtol=1e-6)
+
+
+def test_request_validation_parity(frontend):
+    cases = [
+        ({"input": [1, 2]}, "/api", 400),                # no codec
+        ({"codec": "list"}, "/api", 400),                # no input
+        ({"input": [1], "codec": "nope"}, "/api", 400),  # bad codec
+        ({"input": [1, 2], "codec": "list"}, "/api", 400),  # bad shape
+        ({"input": "x", "codec": "base64"}, "/api", 400),   # no shape
+        ({"input": [1], "codec": "list"}, "/nope", 404),
+    ]
+    for payload, path, want in cases:
+        status, reply, _ = _post(frontend.port, payload, path=path)
+        assert status == want, (payload, path, status)
+        assert "error" in reply
+    status, reply, _ = _post(frontend.port, {"input": [1], "codec": "list"},
+                             content_type="text/plain")
+    assert status == 400
+    # the error echoes the request id too
+    status, reply, _ = _post(frontend.port,
+                             {"codec": "list", "id": 42})
+    assert status == 400 and reply["id"] == 42
+
+
+def test_batch_endpoint(frontend, model):
+    xs = numpy.random.RandomState(1).rand(5, 144).astype(numpy.float32)
+    status, reply, _ = _post(frontend.port,
+                             {"inputs": xs.tolist(), "codec": "list",
+                              "id": "b1"},
+                             path="/api/batch")
+    assert status == 200 and reply["id"] == "b1"
+    numpy.testing.assert_allclose(reply["results"], model(xs), rtol=1e-5)
+    # base64 whole-batch form: leading batch dim in shape
+    status, reply, _ = _post(frontend.port, {
+        "input": base64.b64encode(xs.tobytes()).decode(),
+        "codec": "base64", "shape": [5, 144], "type": "float32"},
+        path="/api/batch")
+    assert status == 200
+    numpy.testing.assert_allclose(reply["results"], model(xs), rtol=1e-5)
+    # validation
+    status, reply, _ = _post(frontend.port,
+                             {"inputs": [], "codec": "list"},
+                             path="/api/batch")
+    assert status == 400 and "error" in reply
+
+
+def test_concurrent_clients_all_answered_correctly(frontend, model):
+    xs = numpy.random.RandomState(2).rand(32, 144).astype(numpy.float32)
+    expected = model(xs)
+    results = {}
+
+    def ask(i):
+        results[i] = _post(frontend.port,
+                           {"input": xs[i].tolist(), "codec": "list",
+                            "id": i})
+
+    threads = [threading.Thread(target=ask, args=(i,)) for i in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(results) == 32
+    for i, (status, reply, _) in results.items():
+        assert status == 200
+        assert reply["id"] == i  # correlation survives concurrency
+        numpy.testing.assert_allclose(reply["result"], expected[i],
+                                      rtol=1e-5)
+    # the engine actually coalesced: fewer batches than requests
+    snap = frontend.metrics.snapshot()
+    assert snap["batches"]["count"] < snap["batches"]["rows"]
+
+
+def test_metrics_and_healthz_endpoints(frontend):
+    _post(frontend.port, {"input": [0.0] * 144, "codec": "list"})
+    status, snap = _get(frontend.port, "/metrics")
+    assert status == 200
+    assert snap["model"] == {"name": "mnist", "version": 1}
+    ep = snap["endpoints"]["/api"]
+    assert ep["requests"] >= 1 and ep["responses"]["200"] >= 1
+    assert ep["qps"] > 0 and ep["p95_ms"] >= ep["p50_ms"] >= 0
+    assert "queue_depth" in snap and len(snap["replicas"]) == 2
+    status, health = _get(frontend.port, "/healthz")
+    assert status == 200
+    assert health["sample_shape"] == [144]
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(
+            "http://127.0.0.1:%d/other" % frontend.port, timeout=5)
+
+
+class _SlowModel(ServeableModel):
+    def __init__(self, base, delay):
+        super(_SlowModel, self).__init__(base.layers, base.sample_shape,
+                                         name=base.name)
+        self._delay = delay
+
+    def forward_fn(self):
+        inner = super(_SlowModel, self).forward_fn()
+
+        def forward(x):
+            time.sleep(self._delay)
+            return inner(x)
+
+        return forward
+
+
+def test_overload_returns_503_with_retry_after(model):
+    fe = ServingFrontend(_SlowModel(model, 0.4), port=0, replicas=1,
+                         max_batch_size=1, batch_timeout_ms=0,
+                         max_queue=2, response_timeout=30,
+                         warm=False).start()
+    try:
+        x = [0.0] * 144
+        statuses = {}
+        lock = threading.Lock()
+
+        def ask(i):
+            status, reply, headers = _post(fe.port,
+                                           {"input": x, "codec": "list"})
+            with lock:
+                statuses[i] = (status, headers)
+
+        threads = [threading.Thread(target=ask, args=(i,))
+                   for i in range(10)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(statuses) == 10  # every request got an answer
+        shed = [h for s, h in statuses.values() if s == 503]
+        served = [s for s, _ in statuses.values() if s == 200]
+        assert shed, "expected 503s under 5x queue overload"
+        assert served, "some requests must still be served"
+        for headers in shed:
+            assert int(headers["Retry-After"]) >= 1
+        assert frontend_metrics_rejections(fe) == len(shed)
+    finally:
+        fe.stop()
+
+
+def frontend_metrics_rejections(fe):
+    return fe.metrics.snapshot()["rejected_total"]
+
+
+def test_hot_swap_over_live_traffic(model):
+    fe = ServingFrontend(model, port=0, replicas=2, max_batch_size=8,
+                         batch_timeout_ms=2, max_queue=64,
+                         warm=False).start()
+    try:
+        x = numpy.random.RandomState(3).rand(144).astype(numpy.float32)
+        _, before, _ = _post(fe.port, {"input": x.tolist(),
+                                       "codec": "list"})
+        v2 = ServeableModel(
+            [(fn, {k: v + 0.25 for k, v in params.items()})
+             for fn, params in model.layers],
+            model.sample_shape, name=model.name)
+        stop = threading.Event()
+        errors = []
+
+        def hammer():
+            while not stop.is_set():
+                status, _, _ = _post(fe.port, {"input": x.tolist(),
+                                               "codec": "list"})
+                if status not in (200, 503):
+                    errors.append(status)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        swapped = fe.swap_model(v2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors  # no 500s during the swap window
+        assert swapped.version == 2
+        assert fe.store.versions("mnist") == [1, 2]
+        _, after, _ = _post(fe.port, {"input": x.tolist(),
+                                      "codec": "list"})
+        assert not numpy.allclose(after["result"], before["result"])
+        status, health = _get(fe.port, "/healthz")
+        assert health["version"] == 2
+        # geometry mismatch is refused
+        bad = ServeableModel(model.layers, (7,), name=model.name)
+        with pytest.raises(ValueError):
+            fe.swap_model(bad)
+    finally:
+        fe.stop()
+
+
+@pytest.mark.slow
+def test_sustained_overload_soak_never_deadlocks(model):
+    """Long soak at ~2x capacity: a small admission bound, a slow
+    model, and a sustained hammering burst — every request must get an
+    HTTP answer for the whole window and the server must still be
+    healthy afterward."""
+    fe = ServingFrontend(_SlowModel(model, 0.05), port=0, replicas=1,
+                         max_batch_size=4, batch_timeout_ms=1,
+                         max_queue=8, response_timeout=60,
+                         warm=False).start()
+    try:
+        x = [0.0] * 144
+        outcomes = []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                status, _, _ = _post(fe.port, {"input": x,
+                                               "codec": "list"})
+                with lock:
+                    outcomes.append(status)
+
+        threads = [threading.Thread(target=hammer) for _ in range(16)]
+        for t in threads:
+            t.start()
+        time.sleep(15)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        assert outcomes
+        bad = [s for s in outcomes if s not in (200, 503)]
+        assert not bad, "unexpected statuses under soak: %s" % set(bad)
+        assert any(s == 200 for s in outcomes)
+        assert any(s == 503 for s in outcomes)
+        # still serving after the storm
+        status, _, _ = _post(fe.port, {"input": x, "codec": "list"})
+        assert status in (200, 503)
+        status, _ = _get(fe.port, "/healthz")
+        assert status == 200
+    finally:
+        fe.stop()
+
+
+def test_web_status_renders_serving_block(frontend):
+    from veles_tpu.web_status import _STATUS_PAGE, WebStatusServer
+    server = WebStatusServer(host="127.0.0.1", port=0).start()
+    try:
+        _post(frontend.port, {"input": [0.0] * 144, "codec": "list"})
+        reporter = frontend.report_to(("127.0.0.1", server.port),
+                                      interval=0.1)
+        deadline = time.time() + 10
+        wfs = {}
+        while time.time() < deadline and not wfs:
+            status, reply, _ = _post(
+                server.port,
+                {"request": "workflows",
+                 "args": ["name", "mode", "serving"]},
+                path="/service")
+            wfs = reply.get("result") or {}
+            time.sleep(0.05)
+        assert wfs, "reporter never reached the dashboard"
+        entry = next(iter(wfs.values()))
+        assert entry["mode"] == "serve"
+        serving = entry["serving"]
+        assert serving["model"] == {"name": "mnist", "version": 1}
+        for key in ("qps", "queue_depth", "p95_ms", "rejected_total",
+                    "batch_mean_size"):
+            assert key in serving
+        reporter.stop()
+        # the dashboard page knows how to render the block
+        assert "serving" in _STATUS_PAGE and "servingCell" in _STATUS_PAGE
+    finally:
+        server.stop()

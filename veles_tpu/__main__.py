@@ -3,7 +3,10 @@
 The reference's ``veles/__main__.py:136-859``: one command runs a model
 standalone, as a master (``-l``), as a slave (``-m``), resumes from a
 snapshot (``-w``), runs the genetic optimizer (``--optimize``) or an
-ensemble (``--ensemble-train``/``--ensemble-test``). Flags are
+ensemble (``--ensemble-train``/``--ensemble-test``). A leading
+``serve`` subcommand instead starts the dynamic-batching inference
+server over a snapshot or export package
+(``python -m veles_tpu serve --model ...``, see docs/SERVING.md). Flags are
 aggregated from every registered class via the CLI registry
 (``veles/cmdline.py``), seeds come from ``-s`` with the reference's
 ``source:count`` syntax, and config files are Python executed against
@@ -328,6 +331,14 @@ class Main(Logger):
         return self.EXIT_SUCCESS
 
     def run(self, argv=None):
+        if argv is None:
+            argv = sys.argv[1:]
+        if argv and argv[0] == "serve":
+            # the serving engine is its own process shape (no Launcher,
+            # no workflow run loop) with its own flags — dispatch before
+            # the training parser rejects them
+            from veles_tpu.serving.frontend import main as serve_main
+            return serve_main(argv[1:])
         parser = self.init_parser()
         self.args = parser.parse_args(argv)
         self._ran = False

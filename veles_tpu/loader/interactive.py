@@ -4,12 +4,20 @@
 loader whose samples are pushed in from the outside (a shell, a driving
 program, a service endpoint) through :meth:`feed`; serving blocks until
 a sample arrives. The workflow runs in testing (forward-only) mode and
-each fed sample is one test minibatch.
+each fed sample joins the next test minibatch.
 
 ``QueueFedLoader`` is the shared mechanism — it also backs the REST
 inference loader (``veles_tpu/loader/restful.py``) and the socket-fed
 workflow-as-a-service loader (``veles_tpu/zmq_loader.py``), collapsing
 the reference's three bespoke implementations into one.
+
+The reference hard-wired ``minibatch_size=1`` (one request, one full
+forward dispatch). Here a fill drains **up to** ``minibatch_size``
+queued samples at once: the first ``get`` blocks, the rest are taken
+non-blocking, rows past the valid count are explicitly zero-padded and
+``minibatch_size`` carries the valid count — so concurrent feeders
+amortize one forward over the whole batch while a lone feeder still
+gets single-sample latency (nothing ever waits for a batch to fill).
 """
 
 import queue
@@ -49,13 +57,14 @@ class QueueFedLoader(Loader):
     def load_data(self):
         if not self.sample_shape:
             raise ValueError("%s needs sample_shape" % self.name)
-        # geometry: an endless test-class stream; one sample per batch
-        self.class_lengths = [1, 0, 0]
-        self.max_minibatch_size = 1
+        # geometry: an endless test-class stream, max_minibatch_size
+        # samples per fill (the valid count rides in minibatch_size)
+        self.class_lengths = [self.max_minibatch_size, 0, 0]
 
     def create_minibatch_data(self):
         self.minibatch_data.reset(numpy.zeros(
-            (1,) + self.sample_shape, numpy.float32))
+            (self.max_minibatch_size,) + self.sample_shape,
+            numpy.float32))
 
     def fill_minibatch(self):
         item = self._queue_.get(timeout=self.feed_timeout)
@@ -68,8 +77,30 @@ class QueueFedLoader(Loader):
             return
         mb = self.minibatch_data.map_invalidate()
         mb[0] = item.reshape(self.sample_shape)
+        count = 1
+        eof_seen = False
+        # opportunistic drain: whatever is ALREADY queued joins this
+        # batch; never block waiting for more (single-feeder latency)
+        while count < self.max_minibatch_size:
+            try:
+                item = self._queue_.get_nowait()
+            except queue.Empty:
+                break
+            if item is self.EOF:
+                eof_seen = True
+                break
+            mb[count] = item.reshape(self.sample_shape)
+            count += 1
+        if count < self.max_minibatch_size:
+            # explicit padding: stale rows from the previous fill must
+            # not leak into consumers that read the full buffer
+            mb[count:] = 0
         self.minibatch_class = TEST
-        self.minibatch_size = 1
+        self.minibatch_size = count
+        if eof_seen:
+            # the EOF terminates the stream AFTER this batch is served:
+            # put it back so the next fill sees it first
+            self._queue_.put(self.EOF)
 
 
 class InteractiveLoader(QueueFedLoader):

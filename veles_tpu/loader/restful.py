@@ -3,7 +3,16 @@
 Pairs with :class:`veles_tpu.restful_api.RESTfulAPI`: each HTTP request
 pushes its decoded sample here, the workflow's forward pass runs, and
 the API unit reads the output back. Mechanism shared with the
-interactive loader (one queue-fed test minibatch per request).
+interactive loader (queue-fed test minibatches).
+
+The reference pinned ``minibatch_size=1``. Pass a larger
+``minibatch_size`` and concurrent HTTP requests coalesce into one
+forward (link the API's ``batch_size`` to this loader's
+``minibatch_size`` so one pass answers every coalesced request)::
+
+    loader = RestfulLoader(wf, sample_shape=(4,), minibatch_size=8)
+    api = RESTfulAPI(wf, ...)
+    api.link_attrs(loader, ("batch_size", "minibatch_size"))
 """
 
 import numpy
@@ -12,7 +21,7 @@ from veles_tpu.loader.interactive import QueueFedLoader
 
 
 class RestfulLoader(QueueFedLoader):
-    """One HTTP request = one test minibatch."""
+    """HTTP requests become (possibly coalesced) test minibatches."""
 
     def __init__(self, workflow, **kwargs):
         kwargs.setdefault("minibatch_size", 1)

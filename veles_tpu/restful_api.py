@@ -10,9 +10,17 @@ validation, base64 shape/type requirements) as the reference.
 
 The reference served through Twisted's reactor; here the server is a
 stdlib :class:`~http.server.ThreadingHTTPServer` on a daemon thread —
-the workflow side stays single-dispatch (the TPU-friendly scheduler in
-:mod:`veles_tpu.workflow`), requests rendezvous with it through the
-loader's feed queue and a matching FIFO of pending responses.
+requests rendezvous with the workflow's run loop through the loader's
+feed queue and a matching FIFO of pending responses. Beyond the
+reference: admission is bounded (``max_pending``; excess requests get
+an immediate 503 + ``Retry-After`` instead of blocking), responses
+echo the request's opaque ``"id"`` so concurrent clients can
+correlate, and one forward pass answers up to ``batch_size`` pending
+requests when the loader serves coalesced fills (link it:
+``api.link_attrs(loader, ("batch_size", "minibatch_size"))``).
+For production serving traffic, prefer the dedicated dynamic-batching
+engine in :mod:`veles_tpu.serving` (``docs/SERVING.md``), which shares
+this module's request contract via :func:`parse_payload`.
 
 Wiring (see ``tests/test_restful.py``)::
 
@@ -49,6 +57,82 @@ class _NumpyJSONEncoder(json.JSONEncoder):
         return super(_NumpyJSONEncoder, self).default(obj)
 
 
+def respond_json(handler, code, payload, headers=None):
+    """Write one JSON response (numpy-aware) with Content-Length and
+    optional extra headers — the response half of the request contract,
+    shared by this unit and the serving frontend."""
+    body = json.dumps(payload, cls=_NumpyJSONEncoder).encode("utf-8")
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    for key, value in (headers or {}).items():
+        handler.send_header(key, value)
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def decode_base64_payload(request):
+    """The base64 codec: needs "shape" and "type" attributes.
+
+    Returns ``(array, None)`` or ``(None, error_message)``; shared by
+    the workflow-riding API and the serving frontend
+    (``veles_tpu/serving/frontend.py``)."""
+    if "shape" not in request:
+        return None, ("There is no \"shape\" attribute which "
+                      "defines the input array shape")
+    shape = request["shape"]
+    if not isinstance(shape, list) or len(shape) < 1:
+        return None, "\"shape\" must be a non-trivial array"
+    if request.get("type") is None:
+        return None, ("There is no \"type\" attribute which "
+                      "defines the array data type (e.g., "
+                      "\"float32\" or \"uint8\", see numpy.dtype)")
+    dtype_name = request["type"]
+    if not isinstance(dtype_name, str):
+        return None, "\"type\" must be a string dtype name"
+    byte_order = None
+    if dtype_name and dtype_name[-1] in "<=>":
+        byte_order = dtype_name[-1]
+        dtype_name = dtype_name[:-1]
+    try:
+        dtype = numpy.dtype(dtype_name)
+    except TypeError:
+        return None, ("Invalid \"type\" value. For the list of "
+                      "supported values, see numpy.dtype.")
+    if byte_order is not None:
+        dtype = dtype.newbyteorder(byte_order)
+    try:
+        buf = base64.b64decode(request["input"])
+    except (binascii.Error, TypeError) as e:
+        return None, "Failed to decode base64: %s." % e
+    try:
+        return numpy.frombuffer(buf, dtype).reshape(shape), None
+    except Exception as e:
+        return None, "Failed to create the numpy array: %s." % e
+
+
+def parse_payload(request):
+    """Validate + decode one ``{"input":..., "codec":...}`` request.
+
+    Returns ``(array, None)`` on success, ``(None, error_message)``
+    otherwise — the single source of the request contract for both
+    HTTP services."""
+    if not isinstance(request, dict) or "input" not in request \
+            or "codec" not in request:
+        return None, ("Invalid input format: there must be "
+                      "\"input\" and \"codec\" attributes")
+    codec = request["codec"]
+    if codec not in ("list", "base64"):
+        return None, ("Invalid codec value: must be either "
+                      "\"list\" or \"base64\"")
+    if codec == "list":
+        try:
+            return numpy.array(request["input"], numpy.float32), None
+        except (TypeError, ValueError):
+            return None, "Invalid input array format"
+    return decode_base64_payload(request)
+
+
 class _APIHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
@@ -57,6 +141,15 @@ class _APIHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         self.server.api.serve(self)
+
+
+class _APIServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # the stdlib default accept backlog (5) drops concurrent connect
+    # bursts into kernel SYN retransmit stalls; an inference endpoint
+    # must accept the burst and shed load at the application layer
+    # (max_pending -> 503) where the client gets a real answer
+    request_queue_size = 128
 
 
 class RESTfulAPI(Unit, TriviallyDistributable):
@@ -76,6 +169,13 @@ class RESTfulAPI(Unit, TriviallyDistributable):
         self.result_transform = kwargs.get("result_transform", None)
         #: seconds a request waits for the workflow before HTTP 500
         self.response_timeout = kwargs.get("response_timeout", 60.0)
+        #: admission bound: further requests get 503 + Retry-After
+        #: instead of blocking unboundedly behind the feed queue
+        self.max_pending = kwargs.get("max_pending", 128)
+        #: how many responses one forward pass answers; link to the
+        #: loader's ``minibatch_size`` when it serves batched fills
+        #: (``api.link_attrs(loader, ("batch_size", "minibatch_size"))``)
+        self.batch_size = 1
         self.address = None
         self.demand("feed", "input")
 
@@ -112,10 +212,8 @@ class RESTfulAPI(Unit, TriviallyDistributable):
     # -- lifecycle ---------------------------------------------------------
 
     def initialize(self, **kwargs):
-        self._server_ = ThreadingHTTPServer(
-            (self.host, self.port), _APIHandler)
+        self._server_ = _APIServer((self.host, self.port), _APIHandler)
         self._server_.api = self
-        self._server_.daemon_threads = True
         self.address = self._server_.server_address
         self.port = self.address[1]
         thread = threading.Thread(target=self._server_.serve_forever,
@@ -142,76 +240,47 @@ class RESTfulAPI(Unit, TriviallyDistributable):
     # -- workflow side -----------------------------------------------------
 
     def run(self):
-        """One forward pass finished: answer the oldest request."""
+        """One forward pass finished: answer the oldest request(s).
+
+        With a batched loader (``batch_size`` linked to the loader's
+        ``minibatch_size``) one pass answers up to ``batch_size``
+        requests — row *i* of the output belongs to the *i*-th oldest
+        pending slot, because feeds and slot appends happen atomically
+        under one lock in queue order."""
+        try:
+            count = max(1, int(self.batch_size))
+        except (TypeError, ValueError):
+            count = 1
         with self._pending_lock_:
             if not self._pending_:
                 return  # e.g. the EOF minibatch that stops the loop
-            slot = self._pending_.pop(0)
-        if slot["abandoned"]:
-            # its client already got a 504; the slot stayed in the FIFO
-            # so sample<->response correlation survives the timeout
-            return
-        out = numpy.array(self.input.map_read()[0], copy=True)
-        slot["result"] = (self.result_transform(out)
-                          if self.result_transform is not None else out)
-        slot["event"].set()
+            count = min(count, len(self._pending_))
+            slots, self._pending_ = (self._pending_[:count],
+                                     self._pending_[count:])
+        out = numpy.array(self.input.map_read()[:count], copy=True)
+        for i, slot in enumerate(slots):
+            if slot["abandoned"]:
+                # its client already got a 504; the slot stayed in the
+                # FIFO so sample<->response correlation survives
+                continue
+            row = out[i]
+            slot["result"] = (self.result_transform(row)
+                              if self.result_transform is not None
+                              else row)
+            slot["event"].set()
 
     # -- HTTP side ---------------------------------------------------------
 
     @staticmethod
-    def _respond(handler, code, payload):
-        body = json.dumps(payload, cls=_NumpyJSONEncoder).encode("utf-8")
-        handler.send_response(code)
-        handler.send_header("Content-Type", "application/json")
-        handler.send_header("Content-Length", str(len(body)))
-        handler.end_headers()
-        handler.wfile.write(body)
+    def _respond(handler, code, payload, headers=None):
+        respond_json(handler, code, payload, headers=headers)
 
-    def fail(self, handler, message, code=400):
+    def fail(self, handler, message, code=400, rid=None, headers=None):
         self.warning(message)
-        self._respond(handler, code, {"error": message})
-
-    def _decode_base64(self, handler, request, input_obj):
-        """The base64 codec: needs "shape" and "type" attributes."""
-        if "shape" not in request:
-            self.fail(handler, "There is no \"shape\" attribute which "
-                               "defines the input array shape")
-            return None
-        shape = request["shape"]
-        if not isinstance(shape, list) or len(shape) < 1:
-            self.fail(handler, "\"shape\" must be a non-trivial array")
-            return None
-        if request.get("type") is None:
-            self.fail(handler, "There is no \"type\" attribute which "
-                               "defines the array data type (e.g., "
-                               "\"float32\" or \"uint8\", see numpy.dtype)")
-            return None
-        dtype_name = request["type"]
-        if not isinstance(dtype_name, str):
-            self.fail(handler, "\"type\" must be a string dtype name")
-            return None
-        byte_order = None
-        if dtype_name and dtype_name[-1] in "<=>":
-            byte_order = dtype_name[-1]
-            dtype_name = dtype_name[:-1]
-        try:
-            dtype = numpy.dtype(dtype_name)
-        except TypeError:
-            self.fail(handler, "Invalid \"type\" value. For the list of "
-                               "supported values, see numpy.dtype.")
-            return None
-        if byte_order is not None:
-            dtype = dtype.newbyteorder(byte_order)
-        try:
-            buf = base64.b64decode(input_obj)
-        except (binascii.Error, TypeError) as e:
-            self.fail(handler, "Failed to decode base64: %s." % e)
-            return None
-        try:
-            return numpy.frombuffer(buf, dtype).reshape(shape)
-        except Exception as e:
-            self.fail(handler, "Failed to create the numpy array: %s." % e)
-            return None
+        payload = {"error": message}
+        if rid is not None:
+            payload["id"] = rid
+        self._respond(handler, code, payload, headers=headers)
 
     def serve(self, handler):
         """Runs on the HTTP thread: decode, feed, wait, respond."""
@@ -249,37 +318,30 @@ class RESTfulAPI(Unit, TriviallyDistributable):
         except (ValueError, UnicodeDecodeError):
             self.fail(handler, "Failed to parse JSON")
             return
-        if not isinstance(request, dict) or "input" not in request \
-                or "codec" not in request:
-            self.fail(handler, "Invalid input format: there must be "
-                               "\"input\" and \"codec\" attributes")
+        # the request-id echo: concurrent clients correlate responses
+        # to requests by their own opaque "id" value
+        rid = request.get("id") if isinstance(request, dict) else None
+        data, error = parse_payload(request)
+        if error is not None:
+            self.fail(handler, error, rid=rid)
             return
-        codec = request["codec"]
-        if codec not in ("list", "base64"):
-            self.fail(handler, "Invalid codec value: must be either "
-                               "\"list\" or \"base64\"")
-            return
-        if codec == "list":
-            try:
-                data = numpy.array(request["input"], numpy.float32)
-            except (TypeError, ValueError):
-                self.fail(handler, "Invalid input array format")
-                return
-        else:
-            data = self._decode_base64(handler, request, request["input"])
-            if data is None:
-                return
         slot = {"event": threading.Event(), "result": None, "error": None,
                 "abandoned": False}
         # feed + pending append under one lock: the loader queue and the
         # response FIFO must agree on ordering across HTTP threads
         feed_error = None
         stopped = False
+        overloaded = False
         with self._pending_lock_:
             if self._server_ is None:
                 # stop() already drained _pending_; feeding now would
                 # block this client for the whole response_timeout
                 stopped = True
+            elif self.max_pending and \
+                    len(self._pending_) >= self.max_pending:
+                # fail fast instead of stacking blocked HTTP threads
+                # behind a workflow that is already saturated
+                overloaded = True
             else:
                 try:
                     self.feed(data)
@@ -288,10 +350,17 @@ class RESTfulAPI(Unit, TriviallyDistributable):
                 else:
                     self._pending_.append(slot)
         if stopped:
-            self.fail(handler, "service stopped", code=503)
+            self.fail(handler, "service stopped", code=503, rid=rid,
+                      headers={"Retry-After": "5"})
+            return
+        if overloaded:
+            self.fail(handler, "service overloaded: %d requests already "
+                               "pending" % self.max_pending,
+                      code=503, rid=rid, headers={"Retry-After": "1"})
             return
         if feed_error is not None:
-            self.fail(handler, "Invalid input value: %s" % feed_error)
+            self.fail(handler, "Invalid input value: %s" % feed_error,
+                      rid=rid)
             return
         if not slot["event"].wait(self.response_timeout):
             # do NOT remove the slot: the sample is already in the
@@ -301,9 +370,12 @@ class RESTfulAPI(Unit, TriviallyDistributable):
             with self._pending_lock_:
                 slot["abandoned"] = True
             self.fail(handler, "The workflow did not respond in time",
-                      code=500)
+                      code=500, rid=rid)
             return
         if slot["error"] is not None:
-            self.fail(handler, slot["error"], code=500)
+            self.fail(handler, slot["error"], code=500, rid=rid)
             return
-        self._respond(handler, 200, {"result": slot["result"]})
+        payload = {"result": slot["result"]}
+        if rid is not None:
+            payload["id"] = rid
+        self._respond(handler, 200, payload)

@@ -1,0 +1,32 @@
+"""Dynamic-batching inference serving (a new layer over the platform).
+
+The training side of the stack serves HTTP through
+:class:`~veles_tpu.restful_api.RESTfulAPI` riding a live workflow: one
+request, one forward dispatch. This package is the production serving
+path the ROADMAP north star asks for — concurrent requests coalesce
+into hardware-sized batches, one jitted forward runs per batch, and a
+pool of warm model replicas absorbs the traffic:
+
+* :mod:`~veles_tpu.serving.model_store` — load serveable models from
+  :class:`~veles_tpu.snapshotter.SnapshotterToFile` outputs, live
+  workflows or ``export/`` packages; version pinning and hot-swap.
+* :mod:`~veles_tpu.serving.replica` — N model replicas with warm JIT
+  caches keyed by batch-shape buckets, least-loaded dispatch.
+* :mod:`~veles_tpu.serving.engine` — the dynamic batcher: bounded
+  admission queue, pad-to-bucket batching, scatter back to futures.
+* :mod:`~veles_tpu.serving.frontend` — the HTTP frontend (same request
+  contract as ``restful_api``), overload → 503 + ``Retry-After``.
+* :mod:`~veles_tpu.serving.metrics` — QPS / queue depth / batch
+  occupancy / latency percentiles, exposed at ``/metrics`` and pushed
+  to the :mod:`~veles_tpu.web_status` dashboard.
+
+Entry point: ``python -m veles_tpu serve --model <snapshot-or-package>``
+(see ``docs/SERVING.md``).
+"""
+
+from veles_tpu.serving.engine import DynamicBatcher, EngineOverloaded
+from veles_tpu.serving.model_store import ModelStore, ServeableModel
+from veles_tpu.serving.replica import Replica, ReplicaPool
+
+__all__ = ["DynamicBatcher", "EngineOverloaded", "ModelStore",
+           "ServeableModel", "Replica", "ReplicaPool"]

@@ -1,0 +1,181 @@
+"""The dynamic batcher: coalesce requests, one forward per batch.
+
+Requests enter through :meth:`DynamicBatcher.submit` (one sample → one
+:class:`concurrent.futures.Future`). Admission is a **bounded** queue:
+when it is full, ``submit`` raises :class:`EngineOverloaded`
+immediately — the frontend turns that into HTTP 503 + ``Retry-After``,
+so overload sheds load instead of stacking unbounded blocked threads
+(the failure mode the old one-request-one-dispatch path had).
+
+The batcher thread collects up to ``max_batch_size`` samples or waits
+at most ``batch_timeout_ms`` past the first sample of a batch — the
+standard latency/throughput knob: a lone request pays at most the
+window; a burst fills the batch instantly and never waits. Collected
+batches go to the replica pool (least-loaded replica, padded to a warm
+bucket) and results scatter back row-by-row to the waiting futures.
+Dispatch is asynchronous: while replica A runs batch N, the batcher is
+already collecting batch N+1 for replica B.
+"""
+
+import concurrent.futures
+import queue
+import threading
+import time
+
+import numpy
+
+from veles_tpu.logger import Logger
+
+
+class EngineOverloaded(Exception):
+    """Admission queue full — retry later (HTTP 503)."""
+
+    def __init__(self, message="serving queue is full", retry_after=1):
+        super(EngineOverloaded, self).__init__(message)
+        self.retry_after = int(retry_after)
+
+
+class _Request(object):
+    __slots__ = ("sample", "future", "enqueued_at")
+
+    def __init__(self, sample):
+        self.sample = sample
+        self.future = concurrent.futures.Future()
+        self.enqueued_at = time.time()
+
+
+class DynamicBatcher(Logger):
+    """Collect → pad → forward → scatter, against a replica pool."""
+
+    def __init__(self, pool, max_batch_size=None, batch_timeout_ms=5.0,
+                 max_queue=256, metrics=None):
+        super(DynamicBatcher, self).__init__()
+        self.pool = pool
+        self.max_batch_size = int(max_batch_size or pool.max_batch_size)
+        self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
+        self._queue = queue.Queue()
+        # admission bounds TOTAL outstanding samples (waiting for the
+        # batcher + dispatched to a replica but not yet scattered) —
+        # bounding only the pre-batcher queue would let the unbounded
+        # replica queues absorb arbitrary backlog and defeat the 503
+        self.max_queue = int(max_queue)
+        self._outstanding = 0
+        self._outstanding_lock = threading.Lock()
+        self.metrics = metrics
+        if metrics is not None:
+            metrics.attach_queue_depth(self.queue_depth)
+            metrics.attach_replica_stats(pool.stats)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._batch_loop,
+                                        daemon=True, name="batcher")
+        self._thread.start()
+
+    # -- request side ------------------------------------------------------
+
+    def submit(self, sample):
+        """One sample in, one Future out; EngineOverloaded when full."""
+        sample = numpy.ascontiguousarray(sample, numpy.float32)
+        expected = self.pool.model.sample_shape
+        if tuple(sample.shape) != expected:
+            try:
+                sample = sample.reshape(expected)
+            except ValueError:
+                raise ValueError(
+                    "sample shape %s does not match the model's %s" %
+                    (tuple(sample.shape), expected))
+        request = _Request(sample)
+        if self._stop.is_set():
+            raise EngineOverloaded("engine stopped", retry_after=5)
+        with self._outstanding_lock:
+            if self._outstanding >= self.max_queue:
+                raise EngineOverloaded(retry_after=1)
+            self._outstanding += 1
+        self._queue.put(request)
+        if self._stop.is_set():
+            # stop() may have drained the queue between the check above
+            # and the put — drain again so no request lands on a dead
+            # queue with its future forever unresolved (each item is
+            # popped exactly once, so racing the loop's drain is safe)
+            self._drain_stopped()
+        return request.future
+
+    def _drain_stopped(self):
+        while True:
+            try:
+                request = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            request.future.set_exception(
+                EngineOverloaded("engine stopped", retry_after=5))
+            self._settle(1)
+
+    def _settle(self, n):
+        with self._outstanding_lock:
+            self._outstanding -= n
+
+    def queue_depth(self):
+        """Outstanding samples (admission-queue + in-replica)."""
+        with self._outstanding_lock:
+            return self._outstanding
+
+    # -- batcher thread ----------------------------------------------------
+
+    def _collect(self):
+        """Block for the first sample, then fill the batch until the
+        window closes or the batch is full — and while every replica
+        is still busy, keep growing past the window (continuous
+        batching): dispatching a fragment early would only queue it
+        behind the running batch, whereas growing it matches the batch
+        size to the service rate under load and keeps single-request
+        latency at one window when the pool is idle."""
+        try:
+            first = self._queue.get(timeout=0.05)
+        except queue.Empty:
+            return None
+        batch = [first]
+        deadline = time.time() + self.batch_timeout_s
+        while len(batch) < self.max_batch_size:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                if self.pool.any_idle() or self._stop.is_set():
+                    break
+                remaining = 0.001  # all replicas busy: keep growing
+            try:
+                batch.append(self._queue.get(timeout=remaining))
+            except queue.Empty:
+                if remaining > 0.002 or self.pool.any_idle() \
+                        or self._stop.is_set():
+                    break
+        return batch
+
+    def _batch_loop(self):
+        while not self._stop.is_set():
+            requests = self._collect()
+            if not requests:
+                continue
+            batch = numpy.stack([r.sample for r in requests])
+            self.pool.submit(batch, self._scatter_cb(requests))
+        # engine stopping: fail whatever is still queued
+        self._drain_stopped()
+
+    def _scatter_cb(self, requests):
+        def scatter(result, bucket, error):
+            self._settle(len(requests))
+            if error is not None:
+                for r in requests:
+                    if not r.future.done():
+                        r.future.set_exception(error)
+                return
+            if self.metrics is not None:
+                self.metrics.record_batch(len(requests), bucket)
+            for i, r in enumerate(requests):
+                if not r.future.done():
+                    r.future.set_result(
+                        numpy.array(result[i], copy=True))
+        return scatter
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)

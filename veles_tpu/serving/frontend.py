@@ -1,0 +1,446 @@
+"""HTTP frontend of the serving engine.
+
+Speaks the same request contract as
+:class:`~veles_tpu.restful_api.RESTfulAPI` (``{"input": ...,
+"codec": "list"|"base64"[, "shape", "type", "id"]}`` → ``{"result":
+...[, "id"]}``) so existing clients move over unchanged, plus:
+
+* ``POST <path>/batch`` — ``{"inputs": [...], "codec": "list"}`` (or
+  base64 with a leading batch dim in ``shape``): the rows ride the same
+  dynamic batcher and come back as ``{"results": [...]}`` in order.
+* ``GET /metrics`` — the JSON metrics snapshot
+  (:class:`~veles_tpu.serving.metrics.ServingMetrics`).
+* ``GET /healthz`` — liveness + current model name/version.
+
+Admission control is the engine's bounded queue: overload returns
+**HTTP 503 with a Retry-After header** immediately — the frontend never
+parks a client thread behind a saturated accelerator.
+
+Run standalone: ``python -m veles_tpu serve --model <snapshot|package>``
+(see :func:`main` for flags, ``docs/SERVING.md`` for the operations
+guide). With ``--web-status host:port`` the frontend pushes its metrics
+block to the dashboard, rendered in ``/status.html``.
+"""
+
+import argparse
+import concurrent.futures
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.logger import Logger
+from veles_tpu.restful_api import (_NumpyJSONEncoder, parse_payload,
+                                   respond_json)
+from veles_tpu.serving.engine import DynamicBatcher, EngineOverloaded
+from veles_tpu.serving.metrics import ServingMetrics
+from veles_tpu.serving.model_store import ModelStore
+from veles_tpu.serving.replica import ReplicaPool
+
+
+class _FrontendHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        self.server.frontend.debug("http: " + fmt, *args)
+
+    def do_POST(self):
+        self.server.frontend.handle_post(self)
+
+    def do_GET(self):
+        self.server.frontend.handle_get(self)
+
+
+class _FrontendServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # socketserver's default accept backlog is 5: a burst of >5
+    # concurrent connects overflows the SYN queue and the spilled
+    # clients stall in kernel retransmit (~1s each) — for a server
+    # whose whole point is absorbing concurrent bursts, the backlog
+    # must exceed the expected client count
+    request_queue_size = 128
+
+
+class ServingFrontend(Logger):
+    """The serving process: model store + replica pool + batcher + HTTP.
+
+    ``model`` may be a :class:`ServeableModel` or a path/URI the store
+    can load. ``swap_model(source)`` hot-swaps live traffic onto a new
+    version (drain each replica in turn, promote, re-warm).
+    """
+
+    def __init__(self, model, host="", port=8180, path="/api",
+                 replicas=1, max_batch_size=64, batch_timeout_ms=5.0,
+                 max_queue=256, response_timeout=30.0, warm=True):
+        super(ServingFrontend, self).__init__()
+        self.store = ModelStore()
+        if isinstance(model, str):
+            model = self.store.load(model)
+        else:
+            self.store.add(model, version=model.version)
+        self.path = path
+        self.response_timeout = float(response_timeout)
+        self.metrics = ServingMetrics()
+        self.metrics.set_model(model.name, model.version)
+        self.pool = ReplicaPool(model, n_replicas=replicas,
+                                max_batch_size=max_batch_size, warm=warm)
+        self.engine = DynamicBatcher(
+            self.pool, max_batch_size=max_batch_size,
+            batch_timeout_ms=batch_timeout_ms, max_queue=max_queue,
+            metrics=self.metrics)
+        self._server = _FrontendServer((host, port), _FrontendHandler)
+        self._server.frontend = self
+        self.address = self._server.server_address
+        self._thread = None
+        self._reporter = None
+
+    @property
+    def port(self):
+        return self.address[1]
+
+    @property
+    def model(self):
+        return self.pool.model
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="serving-http")
+        self._thread.start()
+        self.info("serving %s v%d on %s:%d%s (%d replica(s), "
+                  "max batch %d)", self.model.name, self.model.version,
+                  self.address[0] or "0.0.0.0", self.port, self.path,
+                  len(self.pool.replicas), self.pool.max_batch_size)
+        return self
+
+    def stop(self):
+        if self._reporter is not None:
+            self._reporter.stop()
+            self._reporter = None
+        self._server.shutdown()
+        self._server.server_close()
+        self.engine.stop()
+        self.pool.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def swap_model(self, source, name=None, version=None):
+        """Load + register a new model version and promote the pool to
+        it (drain-old / promote-new, one replica at a time)."""
+        if isinstance(source, str):
+            model = self.store.load(source, name=name or self.model.name,
+                                    version=version)
+        else:
+            model = self.store.add(source, version=version)
+        if tuple(model.sample_shape) != tuple(self.model.sample_shape):
+            raise ValueError(
+                "refusing hot-swap: new sample shape %s != serving %s"
+                % (model.sample_shape, self.model.sample_shape))
+        self.pool.swap(model)
+        self.metrics.set_model(model.name, model.version)
+        return model
+
+    def report_to(self, web_status_address, interval=2.0, name=None):
+        """Push the metrics block to a web_status dashboard."""
+        self._reporter = _StatusReporter(
+            self, web_status_address, interval=interval,
+            name=name or self.model.name)
+        self._reporter.start()
+        return self._reporter
+
+    # -- HTTP plumbing -----------------------------------------------------
+
+    @staticmethod
+    def _respond(handler, code, payload, headers=None):
+        respond_json(handler, code, payload, headers=headers)
+
+    def _fail(self, handler, endpoint, message, code=400, rid=None,
+              headers=None, t0=None):
+        if code == 503:
+            # expected shedding under overload — hundreds per second;
+            # the rejected_total metric is the operator's signal
+            self.debug(message)
+        else:
+            self.warning(message)
+        payload = {"error": message}
+        if rid is not None:
+            payload["id"] = rid
+        self._respond(handler, code, payload, headers=headers)
+        self.metrics.record_request(
+            endpoint, code,
+            (time.time() - t0) * 1000.0 if t0 else None)
+
+    def handle_get(self, handler):
+        if handler.path.startswith("/metrics"):
+            self._respond(handler, 200, self.metrics.snapshot())
+        elif handler.path.startswith("/healthz"):
+            self._respond(handler, 200, {
+                "status": "ok", "model": self.model.name,
+                "version": self.model.version,
+                "sample_shape": list(self.model.sample_shape)})
+        else:
+            self._respond(handler, 404, {"error": "not found"})
+
+    def handle_post(self, handler):
+        t0 = time.time()
+        # same body-drain discipline as restful_api: unread bytes on a
+        # keep-alive connection corrupt the next request
+        if handler.headers.get("Transfer-Encoding"):
+            handler.close_connection = True
+            self._fail(handler, handler.path, "Content-Length required "
+                       "(Transfer-Encoding is not supported)", code=411,
+                       t0=t0)
+            return
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            raw = handler.rfile.read(length)
+        except (TypeError, ValueError):
+            handler.close_connection = True
+            self._fail(handler, handler.path, "Invalid Content-Length",
+                       t0=t0)
+            return
+        if handler.path == self.path:
+            endpoint, batched = self.path, False
+        elif handler.path == self.path + "/batch":
+            endpoint, batched = self.path + "/batch", True
+        else:
+            self._fail(handler, handler.path,
+                       "API path %s is not supported" % handler.path,
+                       code=404, t0=t0)
+            return
+        ctype = (handler.headers.get("Content-Type") or "").split(";")[0]
+        if ctype.strip() != "application/json":
+            self._fail(handler, endpoint, "Unsupported Content-Type "
+                       "(must be \"application/json\")", t0=t0)
+            return
+        try:
+            request = json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            self._fail(handler, endpoint, "Failed to parse JSON", t0=t0)
+            return
+        rid = request.get("id") if isinstance(request, dict) else None
+        try:
+            if batched:
+                self._serve_batch(handler, endpoint, request, rid, t0)
+            else:
+                self._serve_one(handler, endpoint, request, rid, t0)
+        except EngineOverloaded as e:
+            self._fail(handler, endpoint, str(e), code=503, rid=rid,
+                       headers={"Retry-After": str(e.retry_after)},
+                       t0=t0)
+
+    def _serve_one(self, handler, endpoint, request, rid, t0):
+        data, error = parse_payload(request)
+        if error is not None:
+            self._fail(handler, endpoint, error, rid=rid, t0=t0)
+            return
+        try:
+            future = self.engine.submit(data)
+        except ValueError as e:
+            self._fail(handler, endpoint, "Invalid input value: %s" % e,
+                       rid=rid, t0=t0)
+            return
+        self._await_and_reply(handler, endpoint, [future], rid, t0,
+                              single=True)
+
+    def _serve_batch(self, handler, endpoint, request, rid, t0):
+        if not isinstance(request, dict) or "codec" not in request or \
+                ("inputs" not in request and "input" not in request):
+            self._fail(handler, endpoint, "Invalid input format: there "
+                       "must be \"inputs\" and \"codec\" attributes",
+                       rid=rid, t0=t0)
+            return
+        if "inputs" in request:
+            rows_spec = request["inputs"]
+            if not isinstance(rows_spec, list) or not rows_spec:
+                self._fail(handler, endpoint,
+                           "\"inputs\" must be a non-empty array",
+                           rid=rid, t0=t0)
+                return
+            if request["codec"] == "list":
+                try:
+                    rows = [numpy.array(r, numpy.float32)
+                            for r in rows_spec]
+                except (TypeError, ValueError):
+                    self._fail(handler, endpoint,
+                               "Invalid input array format", rid=rid,
+                               t0=t0)
+                    return
+            else:
+                rows = []
+                for r in rows_spec:
+                    data, error = parse_payload(
+                        dict(request, input=r, inputs=None))
+                    if error is not None:
+                        self._fail(handler, endpoint, error, rid=rid,
+                                   t0=t0)
+                        return
+                    rows.append(data)
+        else:
+            # base64 with a leading batch dim in "shape"
+            data, error = parse_payload(request)
+            if error is not None:
+                self._fail(handler, endpoint, error, rid=rid, t0=t0)
+                return
+            rows = list(data)
+        futures = []
+        try:
+            for row in rows:
+                futures.append(self.engine.submit(row))
+        except ValueError as e:
+            # rows already admitted still complete; their results are
+            # simply dropped with the failed request
+            self._fail(handler, endpoint, "Invalid input value: %s" % e,
+                       rid=rid, t0=t0)
+            return
+        self._await_and_reply(handler, endpoint, futures, rid, t0,
+                              single=False)
+
+    def _await_and_reply(self, handler, endpoint, futures, rid, t0,
+                         single):
+        try:
+            deadline = t0 + self.response_timeout
+            results = [f.result(timeout=max(deadline - time.time(),
+                                            0.001))
+                       for f in futures]
+        except concurrent.futures.TimeoutError:
+            self._fail(handler, endpoint,
+                       "The model did not respond in time", code=500,
+                       rid=rid, t0=t0)
+            return
+        except EngineOverloaded:
+            raise
+        except Exception as e:
+            self._fail(handler, endpoint, "inference failed: %s"
+                       % (str(e) or type(e).__name__), code=500,
+                       rid=rid, t0=t0)
+            return
+        if single:
+            payload = {"result": results[0]}
+        else:
+            payload = {"results": results}
+        if rid is not None:
+            payload["id"] = rid
+        self._respond(handler, 200, payload)
+        self.metrics.record_request(endpoint, 200,
+                                    (time.time() - t0) * 1000.0)
+
+
+class _StatusReporter(Logger):
+    """POSTs the serving block to web_status ``/update`` periodically
+    (the serving analog of the Launcher's status notifier)."""
+
+    def __init__(self, frontend, address, interval=2.0, name="serving"):
+        super(_StatusReporter, self).__init__()
+        if isinstance(address, str):
+            host, _, port = address.partition(":")
+            address = (host or "127.0.0.1", int(port or 8090))
+        self.url = "http://%s:%d/update" % tuple(address)
+        self.frontend = frontend
+        self.interval = interval
+        self.name = name
+        self.id = str(uuid.uuid4())
+        self._started = time.time()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-status")
+        self._thread.start()
+        return self
+
+    def _payload(self):
+        return {
+            "id": self.id,
+            "name": self.name,
+            "mode": "serve",
+            "master": self.frontend.address[0] or "localhost",
+            "time": time.time() - self._started,
+            "units": len(self.frontend.pool.replicas),
+            "stopped": False,
+            "serving": self.frontend.metrics.dashboard_block(),
+        }
+
+    def _post_once(self):
+        import urllib.request
+        try:
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps(self._payload(),
+                                cls=_NumpyJSONEncoder).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=2.0)
+        except Exception as e:
+            self.debug("web_status push failed: %s", e)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self._post_once()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def main(argv=None):
+    """``python -m veles_tpu serve ...`` / ``veles-tpu-serve``."""
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu serve",
+        description="dynamic-batching inference server")
+    parser.add_argument("--model", required=True,
+                        help="snapshot file/dir/URI or export package")
+    parser.add_argument("--name", default=None,
+                        help="model name in the store (default: from "
+                             "the artifact)")
+    parser.add_argument("--host", default="")
+    parser.add_argument("--port", type=int, default=8180)
+    parser.add_argument("--path", default=root.common.api.path)
+    parser.add_argument("--replicas", type=int, default=1)
+    parser.add_argument("--max-batch-size", type=int, default=64)
+    parser.add_argument("--batch-timeout-ms", type=float, default=5.0)
+    parser.add_argument("--max-queue", type=int, default=256,
+                        help="admission bound; beyond it requests get "
+                             "503 + Retry-After")
+    parser.add_argument("--response-timeout", type=float, default=30.0)
+    parser.add_argument("--web-status", default=None, metavar="HOST:PORT",
+                        help="push serving metrics to this dashboard")
+    parser.add_argument("-v", "--verbosity", default="info",
+                        choices=["debug", "info", "warning", "error"])
+    args = parser.parse_args(argv)
+    import logging
+
+    from veles_tpu.logger import setup_logging
+    setup_logging(getattr(logging, args.verbosity.upper()))
+    store = ModelStore()
+    model = store.load(args.model, name=args.name)
+    frontend = ServingFrontend(
+        model, host=args.host, port=args.port, path=args.path,
+        replicas=args.replicas, max_batch_size=args.max_batch_size,
+        batch_timeout_ms=args.batch_timeout_ms, max_queue=args.max_queue,
+        response_timeout=args.response_timeout)
+    frontend.store = store
+    if args.web_status:
+        frontend.report_to(args.web_status)
+    frontend.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        frontend.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

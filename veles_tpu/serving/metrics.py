@@ -1,0 +1,166 @@
+"""Serving metrics: QPS, queue depth, batch occupancy, latency tails.
+
+One :class:`ServingMetrics` instance is shared by the batcher, the
+replica pool and the HTTP frontend. Everything is lock-protected plain
+Python — recording a sample is a deque append, far below the cost of
+the forward pass it measures. ``snapshot()`` renders the JSON served at
+``/metrics`` and pushed to the :mod:`~veles_tpu.web_status` dashboard.
+
+Percentiles come from a bounded reservoir of the most recent
+``reservoir_size`` latencies (exact over that window, not an estimate
+over all time — the window is what an operator watching a live service
+wants). QPS is counted over a sliding ``qps_window`` seconds.
+"""
+
+import collections
+import threading
+import time
+
+
+def percentile(sorted_values, q):
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_values:
+        return 0.0
+    rank = max(0, min(len(sorted_values) - 1,
+                      int(round(q / 100.0 * (len(sorted_values) - 1)))))
+    return float(sorted_values[rank])
+
+
+class _EndpointStats(object):
+    """Counters + latency reservoir for one endpoint."""
+
+    def __init__(self, reservoir_size, qps_window):
+        self.requests = 0
+        self.responses = collections.Counter()  # status code -> count
+        self.latencies_ms = collections.deque(maxlen=reservoir_size)
+        self.arrivals = collections.deque()     # timestamps, qps window
+        self.qps_window = qps_window
+
+    def record(self, status, latency_ms, now):
+        self.requests += 1
+        self.responses[int(status)] += 1
+        if latency_ms is not None:
+            self.latencies_ms.append(float(latency_ms))
+        self.arrivals.append(now)
+        horizon = now - self.qps_window
+        while self.arrivals and self.arrivals[0] < horizon:
+            self.arrivals.popleft()
+
+    def snapshot(self, now):
+        horizon = now - self.qps_window
+        while self.arrivals and self.arrivals[0] < horizon:
+            self.arrivals.popleft()
+        lat = sorted(self.latencies_ms)
+        return {
+            "requests": self.requests,
+            "responses": {str(k): v for k, v in
+                          sorted(self.responses.items())},
+            "qps": round(len(self.arrivals) / self.qps_window, 2),
+            "p50_ms": round(percentile(lat, 50), 3),
+            "p95_ms": round(percentile(lat, 95), 3),
+            "p99_ms": round(percentile(lat, 99), 3),
+        }
+
+
+class ServingMetrics(object):
+    """Shared, thread-safe metrics hub for one serving process."""
+
+    def __init__(self, reservoir_size=4096, qps_window=10.0):
+        self._lock = threading.Lock()
+        self._reservoir_size = reservoir_size
+        self._qps_window = qps_window
+        self._endpoints = {}
+        self._rejected = 0          # admission-control 503s
+        self._batches = 0
+        self._batch_rows = 0
+        self._batch_capacity = 0    # sum of bucket sizes actually run
+        self._occupancy = collections.deque(maxlen=reservoir_size)
+        self._queue_depth_fn = None
+        self._replica_stats_fn = None
+        self._started = time.time()
+        self._model = {}
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach_queue_depth(self, fn):
+        """``fn() -> int``: live depth of the admission queue."""
+        self._queue_depth_fn = fn
+
+    def attach_replica_stats(self, fn):
+        """``fn() -> list of per-replica dicts`` (see ReplicaPool)."""
+        self._replica_stats_fn = fn
+
+    def set_model(self, name, version):
+        with self._lock:
+            self._model = {"name": name, "version": version}
+
+    # -- recording ---------------------------------------------------------
+
+    def record_request(self, endpoint, status, latency_ms=None):
+        now = time.time()
+        with self._lock:
+            stats = self._endpoints.get(endpoint)
+            if stats is None:
+                stats = self._endpoints[endpoint] = _EndpointStats(
+                    self._reservoir_size, self._qps_window)
+            stats.record(status, latency_ms, now)
+            if int(status) == 503:
+                self._rejected += 1
+
+    def record_batch(self, rows, bucket):
+        """One engine batch ran: ``rows`` real samples padded to
+        ``bucket``. Occupancy = rows / bucket — the fraction of the
+        compiled batch that was real work."""
+        with self._lock:
+            self._batches += 1
+            self._batch_rows += int(rows)
+            self._batch_capacity += int(bucket)
+            self._occupancy.append(float(rows) / max(int(bucket), 1))
+
+    # -- reading -----------------------------------------------------------
+
+    def snapshot(self):
+        now = time.time()
+        with self._lock:
+            occ = sorted(self._occupancy)
+            per_endpoint = {name: stats.snapshot(now)
+                            for name, stats in self._endpoints.items()}
+            total_qps = round(sum(e["qps"] for e in per_endpoint.values()),
+                              2)
+            out = {
+                "uptime_s": round(now - self._started, 1),
+                "model": dict(self._model),
+                "qps": total_qps,
+                "rejected_total": self._rejected,
+                "endpoints": per_endpoint,
+                "batches": {
+                    "count": self._batches,
+                    "rows": self._batch_rows,
+                    "mean_size": round(
+                        self._batch_rows / max(self._batches, 1), 2),
+                    "occupancy_mean": round(
+                        sum(occ) / max(len(occ), 1), 3),
+                    "occupancy_p50": round(percentile(occ, 50), 3),
+                },
+            }
+        # callables outside the lock: they take their own locks
+        out["queue_depth"] = (self._queue_depth_fn()
+                              if self._queue_depth_fn is not None else 0)
+        if self._replica_stats_fn is not None:
+            out["replicas"] = self._replica_stats_fn()
+        return out
+
+    def dashboard_block(self):
+        """The condensed block pushed to web_status ``/update`` and
+        rendered on ``/status.html`` (QPS, queue depth, p95)."""
+        snap = self.snapshot()
+        lat = [e for e in snap["endpoints"].values()]
+        p95 = max([e["p95_ms"] for e in lat], default=0.0)
+        return {
+            "qps": snap["qps"],
+            "queue_depth": snap["queue_depth"],
+            "p95_ms": p95,
+            "rejected_total": snap["rejected_total"],
+            "batch_mean_size": snap["batches"]["mean_size"],
+            "model": snap["model"],
+        }

@@ -1,0 +1,314 @@
+"""Serveable model loading: snapshots, live workflows, export packages.
+
+A :class:`ServeableModel` is the minimal thing a replica needs to run
+inference: an ordered list of ``(apply_fn, params)`` layers composing a
+pure batch forward, plus the sample shape the frontend validates
+against. Three construction paths cover the platform's artifacts:
+
+* :meth:`ServeableModel.from_workflow` — a live (initialized or
+  restored) workflow with a ``forwards`` chain; the units' own pure
+  ``apply`` methods are reused, so serving math is bit-identical to the
+  training-time forward.
+* :meth:`ServeableModel.from_snapshot` — a
+  :class:`~veles_tpu.snapshotter.SnapshotterToFile` output (plain path,
+  ``_current`` symlink, directory of snapshots, ``http(s)://`` or
+  ``sqlite://`` URI — everything ``import_`` accepts).
+* :meth:`ServeableModel.from_package` — an ``export/`` inference
+  package (directory or ``.tar`` with ``contents.json``); the dense
+  unit classes are rebuilt as standalone closures from the stored
+  weights, no workflow object required.
+
+:class:`ModelStore` keeps named, versioned models with pinning and
+atomic promotion — the hot-swap contract the replica pool drains
+against (see ``docs/SERVING.md``).
+"""
+
+import io
+import json
+import os
+import tarfile
+import threading
+
+import numpy
+
+from veles_tpu.logger import Logger
+
+
+class ModelLoadError(Exception):
+    """The artifact at the given path is not a serveable model."""
+
+
+def _softmax(y):
+    import jax.numpy as jnp
+    z = y - jnp.max(y, axis=1, keepdims=True)
+    e = jnp.exp(z)
+    return e / jnp.sum(e, axis=1, keepdims=True)
+
+
+def _dense_layer(entry, resolve):
+    """Rebuild one package unit as ``(apply_fn, params)``."""
+    cls = entry["class"]["name"]
+    data = entry["data"]
+    if cls in ("All2All", "All2AllTanh", "All2AllRELU",
+               "All2AllStrictRELU", "All2AllSigmoid", "All2AllSoftmax"):
+        from veles_tpu.nn.activation import get_activation
+        activation = data["activation"]
+        out_shape = tuple(data["output_sample_shape"])
+        act = None if activation == "softmax" else \
+            get_activation(activation)
+        params = {"weights": resolve(data["weights"])}
+        if "bias" in data:
+            params["bias"] = resolve(data["bias"])
+
+        def apply(params, x, _act=act, _out=out_shape):
+            import jax.numpy as jnp
+            batch = x.shape[0]
+            y = jnp.dot(x.reshape(batch, -1), params["weights"])
+            if "bias" in params:
+                y = y + params["bias"]
+            y = _softmax(y) if _act is None else _act(y)
+            return y.reshape((batch,) + _out)
+
+        return apply, params
+    if cls == "ActivationUnit":
+        from veles_tpu.nn.activation import get_activation
+        act = get_activation(data["activation"])
+        return (lambda params, x, _act=act: _act(x)), {}
+    if cls == "DropoutForward":
+        # inference: inverted dropout is identity
+        return (lambda params, x: x), {}
+    raise ModelLoadError(
+        "package unit %r is not supported by the serving loader "
+        "(serve the snapshot instead — from_workflow reuses any "
+        "unit's own apply)" % cls)
+
+
+class ServeableModel(object):
+    """An immutable inference function: layers + params + geometry."""
+
+    def __init__(self, layers, sample_shape, name="model", version=1,
+                 source=None):
+        self.layers = list(layers)       # [(apply_fn, params_dict), ...]
+        self.sample_shape = tuple(sample_shape)
+        self.name = name
+        self.version = int(version)
+        self.source = source
+
+    def __repr__(self):
+        return "<ServeableModel %s v%d sample=%s from %s>" % (
+            self.name, self.version, self.sample_shape, self.source)
+
+    def forward_fn(self):
+        """A pure ``fn(x) -> y`` over device arrays, closing over the
+        params — the thing replicas ``jax.jit``."""
+        import jax.numpy as jnp
+        layers = [(fn, {k: jnp.asarray(v) for k, v in params.items()})
+                  for fn, params in self.layers]
+
+        def forward(x):
+            for fn, params in layers:
+                x = fn(params, x)
+            return x
+
+        return forward
+
+    def __call__(self, batch):
+        """Convenience un-warmed forward (tests, sanity checks)."""
+        import jax
+        if getattr(self, "_jitted", None) is None:
+            self._jitted = jax.jit(self.forward_fn())
+        batch = numpy.ascontiguousarray(batch, numpy.float32)
+        return numpy.asarray(self._jitted(batch))
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_workflow(cls, workflow, name=None, version=1, source=None):
+        forwards = getattr(workflow, "forwards", None)
+        if not forwards:
+            raise ModelLoadError(
+                "workflow %r has no forwards chain to serve" % workflow)
+        layers = []
+        for fwd in forwards:
+            if hasattr(fwd, "testing"):
+                # dropout & co. must be identity at serving time
+                fwd.testing = True
+            params = {}
+            if getattr(fwd, "has_weights", False):
+                params["weights"] = numpy.asarray(
+                    fwd.weights.map_read(), numpy.float32)
+                if getattr(fwd, "include_bias", False) and \
+                        fwd.bias.mem is not None:
+                    params["bias"] = numpy.asarray(
+                        fwd.bias.map_read(), numpy.float32)
+            layers.append((fwd.apply, params))
+        sample_shape = cls._workflow_sample_shape(workflow, forwards)
+        return cls(layers, sample_shape,
+                   name=name or getattr(workflow, "name", "model"),
+                   version=version, source=source)
+
+    @staticmethod
+    def _workflow_sample_shape(workflow, forwards):
+        loader = getattr(workflow, "loader", None)
+        if loader is not None and \
+                getattr(loader.minibatch_data, "mem", None) is not None:
+            return tuple(loader.minibatch_data.shape[1:])
+        first = forwards[0]
+        if getattr(first, "has_weights", False) and \
+                first.weights.mem is not None:
+            return (int(first.weights.shape[0]),)
+        raise ModelLoadError("cannot infer the model's sample shape")
+
+    @classmethod
+    def from_snapshot(cls, uri, name=None, version=1):
+        from veles_tpu.snapshotter import SnapshotterToFile
+        workflow = SnapshotterToFile.import_(uri)
+        return cls.from_workflow(workflow, name=name, version=version,
+                                 source=str(uri))
+
+    @classmethod
+    def from_package(cls, path, name=None, version=1):
+        contents, members = _read_package(path)
+        wf_info = contents.get("workflow") or {}
+        arrays = {m: members[m] for m in members}
+
+        def resolve(ref):
+            arr = arrays.get(ref)
+            if arr is None:
+                raise ModelLoadError("package member %r missing" % ref)
+            return numpy.asarray(arr, numpy.float32)
+
+        layers = [_dense_layer(entry, resolve)
+                  for entry in wf_info.get("units", [])]
+        if not layers:
+            raise ModelLoadError("package %s has no units" % path)
+        input_shape = contents.get("input_shape")
+        if input_shape:
+            sample_shape = tuple(input_shape[1:])
+        else:
+            first_w = layers[0][1].get("weights")
+            if first_w is None:
+                raise ModelLoadError("cannot infer sample shape from %s"
+                                     % path)
+            sample_shape = (int(first_w.shape[0]),)
+        return cls(layers, sample_shape,
+                   name=name or wf_info.get("name", "model"),
+                   version=version, source=str(path))
+
+
+def _read_package(path):
+    """contents.json + decoded ``@NNNN`` npy members, dir or tar."""
+    members = {}
+    if os.path.isdir(path):
+        with open(os.path.join(path, "contents.json"), "rb") as f:
+            contents = json.loads(f.read())
+        for fname in os.listdir(path):
+            if fname.startswith("@") and fname.endswith(".npy"):
+                members[fname[:-len(".npy")]] = numpy.load(
+                    os.path.join(path, fname), allow_pickle=False)
+    else:
+        with tarfile.open(path, "r") as tar:
+            contents = json.loads(tar.extractfile("contents.json").read())
+            for info in tar.getmembers():
+                if info.name.startswith("@") and \
+                        info.name.endswith(".npy"):
+                    members[info.name[:-len(".npy")]] = numpy.load(
+                        io.BytesIO(tar.extractfile(info).read()),
+                        allow_pickle=False)
+    return contents, members
+
+
+def _is_package(path):
+    if os.path.isdir(path):
+        return os.path.exists(os.path.join(path, "contents.json"))
+    if str(path).endswith(".tar") and os.path.exists(path):
+        try:
+            with tarfile.open(path, "r") as tar:
+                return "contents.json" in tar.getnames()
+        except tarfile.TarError:
+            return False
+    return False
+
+
+class ModelStore(Logger):
+    """Named, versioned serveable models with pinning.
+
+    ``load()`` auto-detects the artifact kind; versions count up per
+    name. ``get(name)`` returns the pinned version if one is set, else
+    the newest — the replica pool promotes whatever ``get`` returns, so
+    pin-then-swap is the rollback procedure (``docs/SERVING.md``).
+    """
+
+    def __init__(self):
+        super(ModelStore, self).__init__()
+        self._lock = threading.Lock()
+        self._models = {}   # name -> {version: ServeableModel}
+        self._pins = {}     # name -> version
+
+    def load(self, source, name=None, version=None):
+        """Load an artifact and register it; returns the model.
+
+        ``source`` may be an export package (dir / ``.tar`` holding
+        ``contents.json``), a snapshot file or URI, or a snapshot
+        *directory* (the newest snapshot inside is taken — the shape
+        ``SnapshotterToFile`` leaves behind)."""
+        path = str(source)
+        if _is_package(path):
+            model = ServeableModel.from_package(path, name=name)
+        else:
+            if os.path.isdir(path):
+                from veles_tpu.snapshotter import latest_snapshot
+                path = latest_snapshot(path)
+            model = ServeableModel.from_snapshot(path, name=name)
+        return self.add(model, version=version)
+
+    def add(self, model, version=None):
+        with self._lock:
+            versions = self._models.setdefault(model.name, {})
+            if version is None:
+                version = max(versions, default=0) + 1
+            model.version = int(version)
+            versions[model.version] = model
+        self.info("registered %s v%d (from %s)", model.name,
+                  model.version, model.source)
+        return model
+
+    def get(self, name=None, version=None):
+        with self._lock:
+            if name is None:
+                if len(self._models) != 1:
+                    raise KeyError(
+                        "store holds %d models — name one of %s" %
+                        (len(self._models), sorted(self._models)))
+                name = next(iter(self._models))
+            versions = self._models.get(name)
+            if not versions:
+                raise KeyError("no model named %r" % name)
+            if version is None:
+                version = self._pins.get(name, max(versions))
+            model = versions.get(int(version))
+            if model is None:
+                raise KeyError("no version %s of %r (have %s)" %
+                               (version, name, sorted(versions)))
+            return model
+
+    def pin(self, name, version):
+        """Pin ``get(name)`` to an exact version (rollback lever)."""
+        with self._lock:
+            versions = self._models.get(name) or {}
+            if int(version) not in versions:
+                raise KeyError("no version %s of %r (have %s)" %
+                               (version, name, sorted(versions)))
+            self._pins[name] = int(version)
+
+    def unpin(self, name):
+        with self._lock:
+            self._pins.pop(name, None)
+
+    def versions(self, name):
+        with self._lock:
+            return sorted(self._models.get(name, {}))
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)

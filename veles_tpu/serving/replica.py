@@ -1,0 +1,249 @@
+"""Model replicas: warm JIT caches, least-loaded dispatch, hot-swap.
+
+A :class:`Replica` owns one jitted forward of the current model plus a
+worker thread draining its private work queue — the thread-backed
+analog of a per-chip serving process (process isolation is a deployment
+choice layered on top; inside one host, threads share the XLA compile
+cache and the weights' device buffers, which is exactly what we want
+for N replicas of the same model on one chip).
+
+Batch shapes are bucketed to powers of two up to ``max_batch_size``
+(``bucket_for``): the padded batch always hits a warm compilation, so
+tail latency never pays a compile. ``warm()`` pre-compiles every bucket
+at startup and after every swap — a swapped-in model serves its first
+request from a warm cache.
+
+:class:`ReplicaPool` fans work out across replicas by least queued
+work, and :meth:`ReplicaPool.swap` hot-swaps the model: the swap rides
+the same work queue as inference, so each replica drains everything
+already accepted, swaps, re-warms, and only then takes new work — no
+request ever observes a half-swapped replica.
+"""
+
+import queue
+import threading
+
+import numpy
+
+from veles_tpu.logger import Logger
+
+
+def bucket_for(n, max_batch_size):
+    """Smallest power-of-two >= n, clamped to max_batch_size."""
+    if n >= max_batch_size:
+        return max_batch_size
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, max_batch_size)
+
+
+def buckets_upto(max_batch_size):
+    out, b = [], 1
+    while b < max_batch_size:
+        out.append(b)
+        b <<= 1
+    out.append(max_batch_size)
+    return out
+
+
+class _Swap(object):
+    """Queue sentinel: drain, then swap to ``model``."""
+
+    def __init__(self, model):
+        self.model = model
+        self.done = threading.Event()
+
+
+class Replica(Logger):
+    """One warm copy of the model with a private dispatch queue."""
+
+    #: load charged while a swap is queued/running: a swapping replica
+    #: must look maximally busy to pick()/any_idle(), or new batches
+    #: would be routed behind its drain + full re-warm while the other
+    #: replicas sit idle
+    SWAP_LOAD = 1 << 20
+
+    def __init__(self, model, index=0, max_batch_size=64, warm=True):
+        super(Replica, self).__init__()
+        self.index = index
+        self.max_batch_size = int(max_batch_size)
+        self._queue = queue.Queue()
+        self._pending = 0           # queued + running rows, approx load
+        self._pending_lock = threading.Lock()
+        self.batches_done = 0
+        self.rows_done = 0
+        self._stop = threading.Event()
+        self._bind(model, warm=warm)
+        self._thread = threading.Thread(
+            target=self._work_loop, daemon=True,
+            name="replica-%d" % index)
+        self._thread.start()
+
+    # -- model binding -----------------------------------------------------
+
+    def _bind(self, model, warm=True):
+        import jax
+        self.model = model
+        self._forward = jax.jit(model.forward_fn())
+        self.warmed_buckets = []
+        if warm:
+            self.warm()
+
+    def warm(self):
+        """Compile every batch bucket ahead of traffic."""
+        for bucket in buckets_upto(self.max_batch_size):
+            x = numpy.zeros((bucket,) + self.model.sample_shape,
+                            numpy.float32)
+            numpy.asarray(self._forward(x))  # force compile + execute
+            self.warmed_buckets.append(bucket)
+        self.debug("replica %d warm: %s v%d, buckets %s", self.index,
+                   self.model.name, self.model.version,
+                   self.warmed_buckets)
+
+    # -- inference ---------------------------------------------------------
+
+    def infer(self, batch):
+        """Synchronous padded forward (runs on the worker thread)."""
+        rows = batch.shape[0]
+        bucket = bucket_for(rows, self.max_batch_size)
+        if rows < bucket:
+            pad = numpy.zeros((bucket - rows,) + batch.shape[1:],
+                              batch.dtype)
+            batch = numpy.concatenate([batch, pad], axis=0)
+        out = numpy.asarray(self._forward(batch))
+        return out[:rows], bucket
+
+    @property
+    def load(self):
+        with self._pending_lock:
+            return self._pending
+
+    def submit(self, batch, on_done):
+        """Queue a batch; ``on_done(result_rows, bucket, error)`` fires
+        on the worker thread."""
+        with self._pending_lock:
+            self._pending += int(batch.shape[0])
+        self._queue.put((batch, on_done))
+
+    def swap(self, model):
+        """Queue a drain-then-swap; returns an event set when done."""
+        op = _Swap(model)
+        with self._pending_lock:
+            self._pending += self.SWAP_LOAD
+        self._queue.put(op)
+        return op.done
+
+    def _work_loop(self):
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is None:
+                break
+            if isinstance(item, _Swap):
+                try:
+                    self._bind(item.model)
+                    self.info("replica %d promoted to %s v%d",
+                              self.index, item.model.name,
+                              item.model.version)
+                finally:
+                    with self._pending_lock:
+                        self._pending -= self.SWAP_LOAD
+                    item.done.set()
+                continue
+            batch, on_done = item
+            try:
+                result, bucket = self.infer(batch)
+                error = None
+            except Exception as e:  # scatter the failure, don't die
+                result, bucket = None, 0
+                error = e
+                self.exception("replica %d batch failed", self.index)
+            finally:
+                with self._pending_lock:
+                    self._pending -= int(batch.shape[0])
+            self.batches_done += 1
+            self.rows_done += int(batch.shape[0])
+            on_done(result, bucket, error)
+
+    def stop(self):
+        self._stop.set()
+        self._queue.put(None)
+        self._thread.join(timeout=10)
+        # fail whatever was still queued: a stranded batch would leave
+        # its clients blocked until their response timeout
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if isinstance(item, _Swap):
+                with self._pending_lock:
+                    self._pending -= self.SWAP_LOAD
+                item.done.set()
+            elif item is not None:
+                batch, on_done = item
+                on_done(None, 0, RuntimeError("replica stopped"))
+
+    def stats(self):
+        return {"index": self.index, "load": self.load,
+                "batches": self.batches_done, "rows": self.rows_done,
+                "model": self.model.name, "version": self.model.version}
+
+
+class ReplicaPool(Logger):
+    """N replicas of one model; least-loaded dispatch; atomic swap."""
+
+    def __init__(self, model, n_replicas=1, max_batch_size=64,
+                 warm=True):
+        super(ReplicaPool, self).__init__()
+        self.max_batch_size = int(max_batch_size)
+        self._dispatch_lock = threading.Lock()
+        self._rr = 0
+        self.replicas = [
+            Replica(model, index=i, max_batch_size=max_batch_size,
+                    warm=warm)
+            for i in range(max(1, int(n_replicas)))]
+
+    @property
+    def model(self):
+        return self.replicas[0].model
+
+    def pick(self):
+        """Least-loaded replica; round-robin breaks ties so idle
+        replicas alternate instead of replica 0 taking everything."""
+        with self._dispatch_lock:
+            self._rr += 1
+            order = self.replicas[self._rr % len(self.replicas):] + \
+                self.replicas[:self._rr % len(self.replicas)]
+            return min(order, key=lambda r: r.load)
+
+    def any_idle(self):
+        """True when some replica has no queued/running work — the
+        batcher's dispatch gate: while every replica is busy, a forming
+        batch keeps growing instead of queueing up small fragments."""
+        return any(r.load == 0 for r in self.replicas)
+
+    def submit(self, batch, on_done):
+        self.pick().submit(batch, on_done)
+
+    def swap(self, model, timeout=120.0):
+        """Hot-swap every replica, one at a time: each drains its
+        accepted work, promotes, re-warms, and rejoins dispatch while
+        the others keep serving — capacity dips by 1/N, never to 0."""
+        for replica in self.replicas:
+            done = replica.swap(model)
+            if not done.wait(timeout):
+                raise TimeoutError(
+                    "replica %d did not finish the swap in %.0fs" %
+                    (replica.index, timeout))
+        self.info("pool promoted to %s v%d", model.name, model.version)
+
+    def stats(self):
+        return [r.stats() for r in self.replicas]
+
+    def stop(self):
+        for replica in self.replicas:
+            replica.stop()

@@ -303,6 +303,31 @@ class SnapshotterToDB(SnapshotterBase):
         return load_workflow(_maybe_decompress(bytes(row[0])))
 
 
+def latest_snapshot(directory, prefix=None):
+    """Newest snapshot in a :class:`SnapshotterToFile` directory.
+
+    Prefers the ``*_current.pickle*`` symlink the exporter maintains
+    (resolved to its target); falls back to the most recently modified
+    ``*.pickle*`` file on filesystems without symlinks. The serving
+    model store (``veles_tpu/serving/model_store.py``) points at a
+    snapshot directory and gets the freshest checkpoint."""
+    candidates = []
+    for name in os.listdir(directory):
+        if ".pickle" not in name:
+            continue
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        path = os.path.join(directory, name)
+        if "_current.pickle" in name:
+            return os.path.realpath(path)
+        candidates.append(path)
+    if not candidates:
+        raise FileNotFoundError(
+            "no snapshots under %s%s" %
+            (directory, " with prefix %r" % prefix if prefix else ""))
+    return max(candidates, key=os.path.getmtime)
+
+
 def dump_workflow(workflow):
     """Serialize a workflow to bytes (header + graph + PRNG registry)."""
     launcher = workflow._workflow

@@ -46,15 +46,23 @@ th { background: #eee; }
 <a href="/frontend.html">command composer</a></p>
 <table id="wf"><thead><tr>
 <th>id</th><th>name</th><th>mode</th><th>master</th><th>uptime</th>
-<th>slaves</th><th>units</th><th>stopped</th>
+<th>slaves</th><th>units</th><th>serving</th><th>stopped</th>
 </tr></thead><tbody></tbody></table>
 <script>
+function servingCell(s) {
+  if (!s) return "";
+  const model = s.model && s.model.name
+    ? s.model.name + " v" + s.model.version + " · " : "";
+  return model + (s.qps || 0) + " qps · q" + (s.queue_depth || 0) +
+    " · p95 " + (s.p95_ms || 0) + "ms" +
+    (s.rejected_total ? " · " + s.rejected_total + " shed" : "");
+}
 async function refresh() {
   const resp = await fetch("/service", {method: "POST",
     headers: {"Content-Type": "application/json"},
     body: JSON.stringify({request: "workflows",
       args: ["name", "mode", "master", "time", "slaves", "units",
-             "stopped"]})});
+             "serving", "stopped"]})});
   const data = await resp.json();
   const tbody = document.querySelector("#wf tbody");
   tbody.innerHTML = "";
@@ -63,7 +71,7 @@ async function refresh() {
     const slaves = wf.slaves ? Object.keys(wf.slaves).length : 0;
     for (const v of [mid.slice(0, 8), wf.name, wf.mode, wf.master,
                      Math.round(wf.time) + "s", slaves, wf.units,
-                     wf.stopped]) {
+                     servingCell(wf.serving), wf.stopped]) {
       const td = document.createElement("td");
       td.textContent = v === undefined ? "" : String(v);
       tr.appendChild(td);
