@@ -31,6 +31,10 @@ MIN_TIMED_WINDOW_S = 30.0
 #: capability: --precision on the CLI; f32 params + f32 accumulation,
 #: bf16 activations between layers — see veles_tpu/nn/precision.py)
 PRECISION = os.environ.get("VELES_BENCH_PRECISION", "bfloat16")
+#: VELES_BENCH_TELEMETRY=1: span tracing ON through the timed window
+#: (one span per compiled segment) — the <2% overhead guard committed
+#: in docs/PERF.md §Telemetry runs this bench with and without
+TELEMETRY = os.environ.get("VELES_BENCH_TELEMETRY", "0") != "0"
 
 
 def model_train_flops_per_sample(wf):
@@ -97,14 +101,25 @@ def timed_segment_window(trainer, params, states, idx, keys,
     async-queue limit (deeper queues are rejected with
     INVALID_ARGUMENT). Returns (params, states, segments, elapsed_s,
     final_loss)."""
+    from veles_tpu.telemetry import tracing
+    from veles_tpu.telemetry.registry import get_registry
+
+    # chunk-amortized step times land in the registry: the "telemetry"
+    # column scripts/bench_all.py publishes (step p50/p95)
+    step_hist = get_registry().histogram(
+        "veles_bench_step_ms",
+        "Per-segment step time, amortized over one forcing-read chunk")
     chunk = min(20, max(1, 2560 // idx.shape[0]))
     segs = 0
     start = time.time()
     while True:
+        t_chunk = time.time()
         for _ in range(chunk):
-            params, states, losses, _ = trainer._train_segment(
-                params, states, idx, keys)
+            with tracing.span("bench:segment"):
+                params, states, losses, _ = trainer._train_segment(
+                    params, states, idx, keys)
         final_loss = float(losses[-1])
+        step_hist.observe((time.time() - t_chunk) / chunk * 1e3)
         segs += chunk
         elapsed = time.time() - start
         if elapsed >= min_window_s:
@@ -148,6 +163,11 @@ def main():
     from veles_tpu.train import FusedTrainer
 
     set_policy(PRECISION)
+    if TELEMETRY:
+        from veles_tpu.telemetry import tracing
+        tracing.enable()
+        print("telemetry: span tracing ENABLED through the timed window",
+              file=sys.stderr)
     batch = int(os.environ.get("VELES_BENCH_BATCH", 128))
     # 16k samples (bf16-stored, ~5 GB HBM) instead of r2's 1k: the
     # live-loss phase descends visibly from the fresh-model ~6.9
@@ -219,6 +239,12 @@ def main():
         trainer, params, states, idx, keys, MIN_TIMED_WINDOW_S)
     print("timed window: %d epochs x %d samples in %.1fs, loss %.3f -> "
           "%.4f" % (epochs, n_train, elapsed, series[-1], final_loss),
+          file=sys.stderr)
+    from veles_tpu.telemetry.registry import get_registry
+    step = get_registry().get("veles_bench_step_ms").labels()
+    print("telemetry: step p50 %.1f / p95 %.1f ms over %d chunks "
+          "(tracing %s)" % (step.percentile(50), step.percentile(95),
+                            step.count, "on" if TELEMETRY else "off"),
           file=sys.stderr)
 
     samples_per_sec = epochs * n_train / elapsed

@@ -43,18 +43,25 @@ def _seed():
 def _bench_fused(wf):
     """Steady samples/s with bench.py's shared disciplines
     (prepare_segment_run pays compile + settle, then the timed
-    window)."""
+    window). Returns (samples_per_sec, (step_p50_ms, step_p95_ms)) —
+    the step tail comes from the telemetry registry histogram the
+    window feeds."""
     import bench
 
+    from veles_tpu.telemetry.registry import get_registry
     from veles_tpu.train import FusedTrainer
     trainer = FusedTrainer(wf)
     params, states, idx, keys = bench.prepare_segment_run(
         trainer, warm=2, seed=0)
+    step_hist = get_registry().histogram("veles_bench_step_ms")
+    step_hist.reset()  # one config's tail must not leak into the next
     params, states, segs, elapsed, _ = bench.timed_segment_window(
         trainer, params, states, idx, keys, MIN_WINDOW_S)
+    step = step_hist.labels()
     mb = trainer.workflow.loader.max_minibatch_size
     valid = (idx >= 0).sum() / idx.shape[0] / mb  # fill fraction
-    return segs * idx.shape[0] * mb * float(valid) / elapsed
+    return (segs * idx.shape[0] * mb * float(valid) / elapsed,
+            (step.percentile(50), step.percentile(95)))
 
 
 # -- config builders -------------------------------------------------------
@@ -199,8 +206,8 @@ def main():
           % (peak, PRECISION, MIN_WINDOW_S), file=sys.stderr)
 
     print("| Config | samples/s | model GFLOP/sample | eff TFLOP/s "
-          "| MFU |")
-    print("|---|---|---|---|---|")
+          "| MFU | step p50/p95 ms |")
+    print("|---|---|---|---|---|---|")
     for name in names:
         t0 = time.time()
         if name == "serving":
@@ -216,17 +223,19 @@ def main():
             continue
         if name == "som":
             rate, flops, label = bench_som()
+            step_tail = None  # no segment histogram on the SOM path
         else:
             build, label = CONFIGS[name]
             wf = build()
             wf.initialize(device=Device(backend=None))
             flops = bench.model_train_flops_per_sample(wf)
-            rate = _bench_fused(wf)
+            rate, step_tail = _bench_fused(wf)
         eff = rate * flops / 1e12
-        print("| %s | **%s** | %.4f | %.2f | %.1f%% |"
+        tail = ("%.1f / %.1f" % step_tail if step_tail else "—")
+        print("| %s | **%s** | %.4f | %.2f | %.1f%% | %s |"
               % (label,
                  ("{:,.0f}".format(rate)), flops / 1e9, eff,
-                 100.0 * eff / peak), flush=True)
+                 100.0 * eff / peak, tail), flush=True)
         print("%s: %.1f samples/s in %.0fs total"
               % (name, rate, time.time() - t0), file=sys.stderr)
 

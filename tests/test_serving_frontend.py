@@ -157,9 +157,48 @@ def test_concurrent_clients_all_answered_correctly(frontend, model):
     assert snap["batches"]["count"] < snap["batches"]["rows"]
 
 
+def test_request_id_header_becomes_trace_id(frontend):
+    """Satellite (ISSUE 4): a client-supplied X-Request-Id is the trace
+    id of the request's span in --trace-out dumps."""
+    from veles_tpu.telemetry import tracing
+    buf = tracing.TraceBuffer()
+    tracing.enable(buffer=buf)
+    try:
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api" % frontend.port,
+            data=json.dumps({"input": [0.0] * 144,
+                             "codec": "list"}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "req-abc-42"}, method="POST")
+        with urllib.request.urlopen(req, timeout=20) as resp:
+            assert resp.status == 200
+        # the span closes on the handler thread AFTER the response is
+        # written — poll briefly instead of racing it
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            spans = [e for e in buf.events() if e["name"] == "http:/api"]
+            if any(e["args"].get("trace_id") == "req-abc-42"
+                   for e in spans):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("no http:/api span with trace id: %r" % spans)
+    finally:
+        tracing.disable()
+
+
 def test_metrics_and_healthz_endpoints(frontend):
     _post(frontend.port, {"input": [0.0] * 144, "codec": "list"})
-    status, snap = _get(frontend.port, "/metrics")
+    # /metrics is now the Prometheus text exposition (ISSUE 4); the
+    # JSON snapshot the dashboard consumes moved to /metrics.json
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % frontend.port,
+            timeout=10) as resp:
+        assert resp.status == 200
+        assert "text/plain" in resp.headers["Content-Type"]
+        text = resp.read().decode()
+    assert "veles_serving_requests_total{" in text
+    status, snap = _get(frontend.port, "/metrics.json")
     assert status == 200
     assert snap["model"] == {"name": "mnist", "version": 1}
     ep = snap["endpoints"]["/api"]

@@ -70,6 +70,13 @@ class Main(Logger):
                                  "or initialization (init)")
         parser.add_argument("--workflow-graph", default=None,
                             help="write the workflow DOT graph to this file")
+        parser.add_argument("--trace-out", default=None, metavar="FILE",
+                            help="enable span tracing and dump the trace "
+                                 "buffer (Chrome trace-event JSON, open "
+                                 "in Perfetto) to FILE at exit; on a "
+                                 "master/slave pair pointed at the same "
+                                 "FILE the dumps merge into one "
+                                 "correlated timeline")
         parser.add_argument("--result-file", default=None,
                             help="write gathered results JSON here")
         parser.add_argument("--optimize", default=None, metavar="GENS:POP",
@@ -385,6 +392,16 @@ class Main(Logger):
         if self.args.dump_config:
             root.print_()
 
+        if self.args.trace_out:
+            from veles_tpu.telemetry import tracing
+            tracing.enable()
+            # the exit-dump merge is for the processes of ONE run
+            # (master + slaves); a file left by a previous run must
+            # not leak its stale timeline into this one
+            try:
+                os.remove(self.args.trace_out)
+            except OSError:
+                pass
         try:
             if self.args.optimize:
                 return self._run_optimize(module)
@@ -396,6 +413,15 @@ class Main(Logger):
         except KeyboardInterrupt:
             self.warning("interrupted")
             return self.EXIT_FAILURE
+        finally:
+            if self.args.trace_out:
+                from veles_tpu.telemetry import tracing
+                n = tracing.get_buffer().dump(
+                    self.args.trace_out,
+                    process_name=getattr(getattr(self, "launcher", None),
+                                         "mode", None) or "veles_tpu")
+                self.info("wrote %d trace events to %s", n,
+                          self.args.trace_out)
 
 
 def main(argv=None):

@@ -32,9 +32,15 @@ from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.parallel import wire
+from veles_tpu.telemetry import tracing
+from veles_tpu.telemetry.registry import get_registry
 
 _encode = wire.encode
 _decode = wire.decode
+
+
+def _blob_nbytes(blob):
+    return blob.nbytes if isinstance(blob, wire.Chunks) else len(blob)
 
 
 def parse_address(spec, default_host="127.0.0.1", default_port=5000):
@@ -67,6 +73,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         "nodes", "respawn", "slave_command", "eager", "segment_size",
         "pipeline", "secret", "secret_file", "max_frame_mb",
         "interactive", "exchange_dtype", "exchange_eps",
+        "heartbeat_interval",
     ])
 
     def __init__(self, **kwargs):
@@ -89,6 +96,9 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "slave_death_probability", 0.0)
         self.job_timeout = kwargs.get("job_timeout")
         self.heartbeat_timeout = kwargs.get("heartbeat_timeout", 10.0)
+        #: slave: seconds between heartbeats (each reports the previous
+        #: beat's RTT, aggregated on the master per slave)
+        self.heartbeat_interval = kwargs.get("heartbeat_interval", 2.0)
         self.max_idle = kwargs.get("max_idle")
         self.nodes = kwargs.get("nodes")
         self.respawn = kwargs.get("respawn", False)
@@ -316,6 +326,21 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             self.info("serving fused segment jobs (%d minibatches each)",
                       self.segment_size)
 
+        # per-slave exchange telemetry, aggregated on the master: these
+        # are the series the wire-level optimizations (PR 2) were
+        # provable only through one-off bench scripts before
+        registry = get_registry()
+        m_bytes = registry.counter(
+            "veles_exchange_bytes_total",
+            "Payload bytes exchanged with each slave",
+            labels=("slave", "direction"))
+        m_encode_ms = registry.histogram(
+            "veles_exchange_encode_ms",
+            "Master time encoding one job payload", labels=("slave",))
+        m_decode_ms = registry.histogram(
+            "veles_exchange_decode_ms",
+            "Master time decoding one slave update", labels=("slave",))
+
         def job_source(slave):
             try:
                 if segments:
@@ -327,6 +352,9 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                 raise NoMoreJobsError()
             if data is None:
                 return None
+            # encode_ms brackets the WHOLE payload transform — the
+            # delta diff/cast is the expensive half at model scale
+            t0 = time.perf_counter()
             if self.exchange_dtype is not None:
                 # per-slave delta stream: first push full, then deltas
                 # (state is connection-scoped on both ends, so a
@@ -344,12 +372,24 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                 # into the shared segment, no pickle byte-string ever
                 # materializes (docs/PERF.md r5: that pickle pass alone
                 # cost 1.8 s at AlexNet-227 scale)
-                return {"blob": wire.encode_chunks(data)}
-            # remote slaves get zlib-compressed binary frames
-            return {"blob": _encode(data, compress=True)}
+                blob = wire.encode_chunks(data)
+            else:
+                # remote slaves get zlib-compressed binary frames
+                blob = _encode(data, compress=True)
+            m_encode_ms.labels(slave=slave.id).observe(
+                (time.perf_counter() - t0) * 1e3)
+            m_bytes.labels(slave=slave.id, direction="to_slave").inc(
+                _blob_nbytes(blob))
+            return {"blob": blob}
 
         def result_sink(data, slave):
-            workflow.apply_data_from_slave(_decode(data["blob"]), slave)
+            t0 = time.perf_counter()
+            payload = _decode(data["blob"])
+            m_decode_ms.labels(slave=slave.id).observe(
+                (time.perf_counter() - t0) * 1e3)
+            m_bytes.labels(slave=slave.id, direction="from_slave").inc(
+                _blob_nbytes(data["blob"]))
+            workflow.apply_data_from_slave(payload, slave)
 
         def on_drop(slave):
             workflow.drop_slave(slave)
@@ -373,6 +413,9 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             job_source=job_source, result_sink=result_sink,
             on_drop=on_drop, initial_data_source=initial_data_source,
             secret=self.secret, max_frame=self.max_frame)
+        # every span this master records carries the run's trace id;
+        # slaves adopt the same id from the handshake reply
+        tracing.set_default_trace_id(self._server.trace_id)
         self.info("master listening on %s:%d", *self._server.address)
         if self.nodes:
             import socket as socket_mod
@@ -446,8 +489,13 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             power=self.workflow.computing_power,
             death_probability=self.slave_death_probability,
             pipeline=self.pipeline, secret=self.secret,
-            max_frame=self.max_frame)
+            max_frame=self.max_frame,
+            heartbeat_interval=self.heartbeat_interval)
         self._client.connect()
+        if self._client.trace_id:
+            # adopt the master's run-wide trace id: this slave's unit/
+            # step spans merge with the master's on one timeline
+            tracing.set_default_trace_id(self._client.trace_id)
         self.info("connected to master as slave %s", self._client.id)
         if self._client.initial_data is not None:
             # the MASTER's negotiates_on_connect state, from the handshake

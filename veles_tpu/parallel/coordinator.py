@@ -33,6 +33,8 @@ import uuid
 from veles_tpu import prng
 from veles_tpu.logger import Logger
 from veles_tpu.parallel import wire
+from veles_tpu.telemetry import tracing
+from veles_tpu.telemetry.registry import get_registry
 
 
 def _blob_len(data):
@@ -480,6 +482,29 @@ class CoordinatorServer(Logger):
         # (``workflow.py:587-594`` generate_initial_data_for_slave)
         self.initial_data_source = initial_data_source
         self.no_more_jobs = False
+        #: ONE trace id for the whole distributed run, handed to every
+        #: slave in the handshake reply so master and slave spans land
+        #: on a single correlated timeline (--trace-out)
+        self.trace_id = uuid.uuid4().hex[:16]
+        registry = get_registry()
+        self._m_rtt_ms = registry.histogram(
+            "veles_slave_heartbeat_rtt_ms",
+            "Heartbeat round-trip as measured by the slave, "
+            "aggregated here", labels=("slave",))
+        self._m_job_ms = registry.histogram(
+            "veles_slave_job_ms",
+            "Per-job wall time from hand-out to result", labels=("slave",))
+        self._m_source_ms = registry.histogram(
+            "veles_job_source_ms",
+            "Master time generating one job payload", labels=("slave",))
+        self._m_sink_ms = registry.histogram(
+            "veles_result_sink_ms",
+            "Master time merging one slave update", labels=("slave",))
+        self._m_jobs = registry.counter(
+            "veles_jobs_total", "Jobs resolved per slave",
+            labels=("slave",))
+        self._m_drops = registry.counter(
+            "veles_slave_drops_total", "Slaves dropped (death/timeout)")
         self.slaves = {}
         self.jobs = []                 # pending job payloads
         self.results = []
@@ -556,6 +581,10 @@ class CoordinatorServer(Logger):
             if dead or overrun:
                 self.warning("dropping slave %s (%s)", sid,
                              "dead" if dead else "job timeout")
+                # counted HERE, not in drop_slave: the connection
+                # handler also calls drop_slave on a clean end-of-run
+                # disconnect, which is not a death/timeout
+                self._m_drops.inc()
                 self.drop_slave(sid)
 
     def drop_slave(self, sid):
@@ -660,7 +689,7 @@ class CoordinatorServer(Logger):
                 sharedio = _prove_same_host(proto)
             slave_desc.sharedio = sharedio
             reply = {"id": sid, "log_id": sid, "sharedio": sharedio,
-                     "mid": hex(uuid.getnode())}
+                     "mid": hex(uuid.getnode()), "trace": self.trace_id}
             if self.initial_data_source is not None:
                 reply["data"] = self.initial_data_source(slave_desc)
             proto.send(reply)
@@ -712,7 +741,7 @@ class CoordinatorServer(Logger):
                     payload = self.jobs.pop(0)
                     slave.jobs_in_flight.append((payload, time.time()))
                     slave.state = "WORK"
-                    return {"job": payload}, False
+                    return self._job_reply(payload), False
                 if self.job_source is None or self.no_more_jobs:
                     if not slave.jobs_in_flight:
                         slave.state = "IDLE"
@@ -723,7 +752,10 @@ class CoordinatorServer(Logger):
                     # results resolve oldest-first (replies are ordered
                     # per connection, so this matches the slave's view)
                     payload, started = slave.jobs_in_flight.pop(0)
-                    self.job_times.append(time.time() - started)
+                    job_elapsed = time.time() - started
+                    self.job_times.append(job_elapsed)
+                    self._m_job_ms.labels(slave=sid).observe(
+                        job_elapsed * 1e3)
                     if slave.jobs_in_flight:
                         # the prefetched job only STARTS computing now:
                         # restart its clock so the adaptive timeout and
@@ -732,6 +764,7 @@ class CoordinatorServer(Logger):
                         slave.jobs_in_flight[0] = (nxt_payload,
                                                    time.time())
                 slave.jobs_done += 1
+                self._m_jobs.labels(slave=sid).inc()
                 if not slave.jobs_in_flight:
                     slave.state = "WAIT"
                 if self.result_sink is None:
@@ -742,16 +775,21 @@ class CoordinatorServer(Logger):
                 action = "sink"
             elif cmd == "heartbeat":
                 slave.power = msg.get("power", slave.power)
+                self._record_rtt(sid, msg)
                 return {"ok": True}, False
             else:
                 return {"error": "unknown cmd %r" % cmd}, False
 
         if action == "source":
             payload = None
+            t0 = time.perf_counter()
             try:
                 payload = self.job_source(slave)
             except NoMoreJobsError:
                 self.no_more_jobs = True
+            if payload is not None:
+                self._m_source_ms.labels(slave=sid).observe(
+                    (time.perf_counter() - t0) * 1e3)
             with self._lock:
                 if sid not in self.slaves:
                     # the reaper dropped this slave while the job was
@@ -764,17 +802,41 @@ class CoordinatorServer(Logger):
                 if payload is not None:
                     slave.jobs_in_flight.append((payload, time.time()))
                     slave.state = "WORK"
-                    return {"job": payload}, False
+                    return self._job_reply(payload), False
                 if not slave.jobs_in_flight:
                     slave.state = "IDLE"
                 return {"job": None, "done": self.no_more_jobs}, False
         # action == "sink"
+        t0 = time.perf_counter()
         try:
             self.result_sink(msg.get("data"), slave)
         finally:
+            elapsed = time.perf_counter() - t0
+            self._m_sink_ms.labels(slave=sid).observe(elapsed * 1e3)
+            if tracing.enabled():
+                # the master half of the exchange span: the slave half
+                # (exchange:job) carries the same span_id
+                trace = msg.get("trace") or {}
+                tracing.add_complete(
+                    "exchange:result", t0, elapsed, slave=sid,
+                    trace_id=trace.get("trace_id", self.trace_id),
+                    span_id=trace.get("span_id"))
             with self._lock:
                 slave.applying = False
         return {"ok": True}, False
+
+    def _job_reply(self, payload):
+        """Job replies carry the run's trace id plus a per-job span id
+        the slave echoes on its result — the correlation handle for
+        the exchange legs."""
+        return {"job": payload,
+                "trace": {"trace_id": self.trace_id,
+                          "span_id": uuid.uuid4().hex[:8]}}
+
+    def _record_rtt(self, sid, msg):
+        rtt = msg.get("rtt_ms")
+        if isinstance(rtt, (int, float)):
+            self._m_rtt_ms.labels(slave=sid).observe(float(rtt))
 
     def snapshot_slaves(self):
         """Consistent copy of the slave registry for outside readers."""
@@ -792,6 +854,7 @@ class CoordinatorServer(Logger):
                 else:
                     slave.last_seen = time.time()
                     slave.power = msg.get("power", slave.power)
+                    self._record_rtt(sid, msg)
                     reply, stop = {"ok": True}, False
             proto.send(reply)
             if stop:
@@ -828,6 +891,10 @@ class CoordinatorClient(Logger):
         self.pipeline = pipeline
         self._rand = prng.get(rand)
         self.id = None
+        #: the master's run-wide trace id (handshake reply); spans on
+        #: this slave adopt it so --trace-out dumps from master and
+        #: slave processes merge into one correlated timeline
+        self.trace_id = None
         self.jobs_done = 0
         self._hb_stop = threading.Event()
 
@@ -876,6 +943,7 @@ class CoordinatorClient(Logger):
         if "error" in reply:
             raise ConnectionError(reply["error"])
         self.id = reply["id"]
+        self.trace_id = reply.get("trace")
         self.initial_data = reply.get("data")
         if reply.get("sharedio"):
             # same machine as the master, proven by the nonce exchange:
@@ -895,11 +963,17 @@ class CoordinatorClient(Logger):
         return self
 
     def _hb_loop(self):
+        # each beat reports the round-trip the PREVIOUS beat measured;
+        # the master aggregates them per slave (heartbeat RTT series)
+        rtt_ms = None
         while not self._hb_stop.wait(self.heartbeat_interval):
             try:
+                t0 = time.perf_counter()
                 self._hb_proto.send({"cmd": "heartbeat",
-                                     "power": self.power})
+                                     "power": self.power,
+                                     "rtt_ms": rtt_ms})
                 self._hb_proto.recv()
+                rtt_ms = (time.perf_counter() - t0) * 1e3
             except (ConnectionError, OSError):
                 return
 
@@ -923,7 +997,7 @@ class CoordinatorClient(Logger):
         pending_job = None
         while True:
             if pending_job is not None:
-                job = pending_job
+                job, job_trace = pending_job
                 pending_job = None
             else:
                 try:
@@ -941,6 +1015,7 @@ class CoordinatorClient(Logger):
                     time.sleep(idle_sleep)
                     continue
                 job = reply["job"]
+                job_trace = reply.get("trace")
             idle = 0
             if self.death_probability and \
                     self._rand.rand() < self.death_probability:
@@ -955,13 +1030,22 @@ class CoordinatorClient(Logger):
                     prefetched = True
                 except (ConnectionError, OSError):
                     prefetched = False
-            result = handler(job)
+            trace = job_trace if isinstance(job_trace, dict) else {}
+            # the slave half of the exchange span: job execution under
+            # the master's trace id, labeled with the job's span id
+            with tracing.request_span("exchange:job",
+                                      trace_id=trace.get("trace_id",
+                                                         self.trace_id),
+                                      span_id=trace.get("span_id"),
+                                      slave=self.id):
+                result = handler(job)
             try:
                 if prefetched:
                     # drain the job reply BEFORE writing the result:
                     # see the write-write deadlock note above
                     next_reply = self.proto.recv()
-                self.proto.send({"cmd": "result", "data": result})
+                self.proto.send({"cmd": "result", "data": result,
+                                 "trace": job_trace})
                 self.proto.recv()  # result ack
             except (ConnectionError, OSError):
                 # master shut down while we were computing — a normal
@@ -970,9 +1054,11 @@ class CoordinatorClient(Logger):
                 return self.jobs_done
             self.jobs_done += 1
             if prefetched:
-                pending_job = next_reply.get("job")
-                if pending_job is None and next_reply.get("done"):
+                nxt = next_reply.get("job")
+                if nxt is None and next_reply.get("done"):
                     return self.jobs_done
+                pending_job = None if nxt is None else \
+                    (nxt, next_reply.get("trace"))
 
     def heartbeat(self):
         self.proto.send({"cmd": "heartbeat", "power": self.power})

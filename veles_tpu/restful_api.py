@@ -41,6 +41,7 @@ import numpy
 
 from veles_tpu.config import root
 from veles_tpu.distributable import TriviallyDistributable
+from veles_tpu.telemetry import tracing
 from veles_tpu.units import Unit
 
 
@@ -321,6 +322,14 @@ class RESTfulAPI(Unit, TriviallyDistributable):
         # the request-id echo: concurrent clients correlate responses
         # to requests by their own opaque "id" value
         rid = request.get("id") if isinstance(request, dict) else None
+        # the same id (or an X-Request-Id header) doubles as the trace
+        # id of this request's span in --trace-out dumps
+        trace_id = tracing.trace_id_from_request(handler.headers, rid)
+        with tracing.request_span("http:%s" % self.path,
+                                  trace_id=trace_id):
+            self._serve_parsed(handler, request, rid)
+
+    def _serve_parsed(self, handler, request, rid):
         data, error = parse_payload(request)
         if error is not None:
             self.fail(handler, error, rid=rid)

@@ -8,9 +8,15 @@ Speaks the same request contract as
 * ``POST <path>/batch`` — ``{"inputs": [...], "codec": "list"}`` (or
   base64 with a leading batch dim in ``shape``): the rows ride the same
   dynamic batcher and come back as ``{"results": [...]}`` in order.
-* ``GET /metrics`` — the JSON metrics snapshot
+* ``GET /metrics`` — Prometheus text exposition of the process-wide
+  telemetry registry (serving + any co-resident training series).
+* ``GET /metrics.json`` — the JSON metrics snapshot
   (:class:`~veles_tpu.serving.metrics.ServingMetrics`).
 * ``GET /healthz`` — liveness + current model name/version.
+
+A client-supplied ``X-Request-Id`` header (or the body's ``"id"``)
+becomes the trace id of the request's span, so a single request can be
+found in a ``--trace-out`` dump by the id the client already logs.
 
 Admission control is the engine's bounded queue: overload returns
 **HTTP 503 with a Retry-After header** immediately — the frontend never
@@ -40,6 +46,8 @@ from veles_tpu.serving.engine import DynamicBatcher, EngineOverloaded
 from veles_tpu.serving.metrics import ServingMetrics
 from veles_tpu.serving.model_store import ModelStore
 from veles_tpu.serving.replica import ReplicaPool
+from veles_tpu.telemetry import tracing
+from veles_tpu.telemetry.registry import get_registry
 
 
 class _FrontendHandler(BaseHTTPRequestHandler):
@@ -178,8 +186,16 @@ class ServingFrontend(Logger):
             (time.time() - t0) * 1000.0 if t0 else None)
 
     def handle_get(self, handler):
-        if handler.path.startswith("/metrics"):
+        if handler.path.startswith("/metrics.json"):
             self._respond(handler, 200, self.metrics.snapshot())
+        elif handler.path.startswith("/metrics"):
+            body = get_registry().render_prometheus().encode("utf-8")
+            handler.send_response(200)
+            handler.send_header("Content-Type",
+                                "text/plain; version=0.0.4")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
         elif handler.path.startswith("/healthz"):
             self._respond(handler, 200, {
                 "status": "ok", "model": self.model.name,
@@ -226,11 +242,16 @@ class ServingFrontend(Logger):
             self._fail(handler, endpoint, "Failed to parse JSON", t0=t0)
             return
         rid = request.get("id") if isinstance(request, dict) else None
+        # request-id → trace-id bridge: the span for this request (and
+        # everything under it) carries the client's X-Request-Id / "id"
+        trace_id = tracing.trace_id_from_request(handler.headers, rid)
         try:
-            if batched:
-                self._serve_batch(handler, endpoint, request, rid, t0)
-            else:
-                self._serve_one(handler, endpoint, request, rid, t0)
+            with tracing.request_span("http:%s" % endpoint,
+                                      trace_id=trace_id):
+                if batched:
+                    self._serve_batch(handler, endpoint, request, rid, t0)
+                else:
+                    self._serve_one(handler, endpoint, request, rid, t0)
         except EngineOverloaded as e:
             self._fail(handler, endpoint, str(e), code=503, rid=rid,
                        headers={"Retry-After": str(e.retry_after)},
@@ -414,6 +435,10 @@ def main(argv=None):
     parser.add_argument("--response-timeout", type=float, default=30.0)
     parser.add_argument("--web-status", default=None, metavar="HOST:PORT",
                         help="push serving metrics to this dashboard")
+    parser.add_argument("--trace-out", default=None, metavar="FILE",
+                        help="enable span tracing and dump the trace "
+                             "buffer (Chrome trace-event JSON, open in "
+                             "Perfetto) to FILE at exit")
     parser.add_argument("-v", "--verbosity", default="info",
                         choices=["debug", "info", "warning", "error"])
     args = parser.parse_args(argv)
@@ -421,6 +446,13 @@ def main(argv=None):
 
     from veles_tpu.logger import setup_logging
     setup_logging(getattr(logging, args.verbosity.upper()))
+    if args.trace_out:
+        tracing.enable()
+        import os
+        try:  # don't merge into a stale file from a previous run
+            os.remove(args.trace_out)
+        except OSError:
+            pass
     store = ModelStore()
     model = store.load(args.model, name=args.name)
     frontend = ServingFrontend(
@@ -439,6 +471,11 @@ def main(argv=None):
         pass
     finally:
         frontend.stop()
+        if args.trace_out:
+            n = tracing.get_buffer().dump(args.trace_out,
+                                          process_name="serve")
+            frontend.info("wrote %d trace events to %s", n,
+                          args.trace_out)
     return 0
 
 

@@ -4,7 +4,15 @@ One :class:`ServingMetrics` instance is shared by the batcher, the
 replica pool and the HTTP frontend. Everything is lock-protected plain
 Python — recording a sample is a deque append, far below the cost of
 the forward pass it measures. ``snapshot()`` renders the JSON served at
-``/metrics`` and pushed to the :mod:`~veles_tpu.web_status` dashboard.
+``/metrics.json`` and pushed to the :mod:`~veles_tpu.web_status`
+dashboard (schema unchanged since PR 3).
+
+The reservoir + nearest-rank percentile machinery that used to live
+here is now the process-wide telemetry core
+(:mod:`veles_tpu.telemetry.registry`); this module imports it and
+additionally mirrors every sample into the shared registry, so the
+serving counters appear in the Prometheus text exposition at
+``/metrics`` next to the training and coordinator series.
 
 Percentiles come from a bounded reservoir of the most recent
 ``reservoir_size`` latencies (exact over that window, not an estimate
@@ -16,14 +24,10 @@ import collections
 import threading
 import time
 
+from veles_tpu.telemetry.registry import (Reservoir, get_registry,
+                                          percentile)
 
-def percentile(sorted_values, q):
-    """Nearest-rank percentile over an already-sorted list."""
-    if not sorted_values:
-        return 0.0
-    rank = max(0, min(len(sorted_values) - 1,
-                      int(round(q / 100.0 * (len(sorted_values) - 1)))))
-    return float(sorted_values[rank])
+__all__ = ["ServingMetrics", "percentile"]
 
 
 class _EndpointStats(object):
@@ -32,7 +36,7 @@ class _EndpointStats(object):
     def __init__(self, reservoir_size, qps_window):
         self.requests = 0
         self.responses = collections.Counter()  # status code -> count
-        self.latencies_ms = collections.deque(maxlen=reservoir_size)
+        self.latencies_ms = Reservoir(reservoir_size)
         self.arrivals = collections.deque()     # timestamps, qps window
         self.qps_window = qps_window
 
@@ -40,7 +44,7 @@ class _EndpointStats(object):
         self.requests += 1
         self.responses[int(status)] += 1
         if latency_ms is not None:
-            self.latencies_ms.append(float(latency_ms))
+            self.latencies_ms.add(float(latency_ms))
         self.arrivals.append(now)
         horizon = now - self.qps_window
         while self.arrivals and self.arrivals[0] < horizon:
@@ -50,7 +54,7 @@ class _EndpointStats(object):
         horizon = now - self.qps_window
         while self.arrivals and self.arrivals[0] < horizon:
             self.arrivals.popleft()
-        lat = sorted(self.latencies_ms)
+        lat = self.latencies_ms.sorted_values()
         return {
             "requests": self.requests,
             "responses": {str(k): v for k, v in
@@ -65,7 +69,8 @@ class _EndpointStats(object):
 class ServingMetrics(object):
     """Shared, thread-safe metrics hub for one serving process."""
 
-    def __init__(self, reservoir_size=4096, qps_window=10.0):
+    def __init__(self, reservoir_size=4096, qps_window=10.0,
+                 registry=None):
         self._lock = threading.Lock()
         self._reservoir_size = reservoir_size
         self._qps_window = qps_window
@@ -79,6 +84,25 @@ class ServingMetrics(object):
         self._replica_stats_fn = None
         self._started = time.time()
         self._model = {}
+        # mirror into the process-wide registry (Prometheus /metrics)
+        registry = registry or get_registry()
+        self._m_requests = registry.counter(
+            "veles_serving_requests_total", "Requests per endpoint",
+            labels=("endpoint", "status"))
+        self._m_latency = registry.histogram(
+            "veles_serving_latency_ms", "End-to-end request latency",
+            labels=("endpoint",), reservoir_size=reservoir_size)
+        self._m_rejected = registry.counter(
+            "veles_serving_rejected_total",
+            "Requests shed by admission control (503)")
+        self._m_batches = registry.counter(
+            "veles_serving_batches_total", "Engine batches run")
+        self._m_batch_rows = registry.counter(
+            "veles_serving_batch_rows_total", "Real samples batched")
+        self._m_occupancy = registry.histogram(
+            "veles_serving_batch_occupancy",
+            "Real rows / compiled bucket size per batch",
+            reservoir_size=reservoir_size)
 
     # -- wiring ------------------------------------------------------------
 
@@ -106,16 +130,27 @@ class ServingMetrics(object):
             stats.record(status, latency_ms, now)
             if int(status) == 503:
                 self._rejected += 1
+        # registry mirrors outside our lock: it takes its own (only) one
+        self._m_requests.labels(endpoint=endpoint,
+                                status=str(int(status))).inc()
+        if latency_ms is not None:
+            self._m_latency.labels(endpoint=endpoint).observe(latency_ms)
+        if int(status) == 503:
+            self._m_rejected.inc()
 
     def record_batch(self, rows, bucket):
         """One engine batch ran: ``rows`` real samples padded to
         ``bucket``. Occupancy = rows / bucket — the fraction of the
         compiled batch that was real work."""
+        occupancy = float(rows) / max(int(bucket), 1)
         with self._lock:
             self._batches += 1
             self._batch_rows += int(rows)
             self._batch_capacity += int(bucket)
-            self._occupancy.append(float(rows) / max(int(bucket), 1))
+            self._occupancy.append(occupancy)
+        self._m_batches.inc()
+        self._m_batch_rows.inc(int(rows))
+        self._m_occupancy.observe(occupancy)
 
     # -- reading -----------------------------------------------------------
 

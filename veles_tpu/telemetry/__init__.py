@@ -1,0 +1,19 @@
+"""Unified telemetry: the process-wide metrics registry and span tracer
+shared by training, the distributed coordinator and the serving engine
+(docs/OBSERVABILITY.md).
+
+>>> from veles_tpu import telemetry
+>>> reqs = telemetry.get_registry().counter(
+...     "myapp_requests_total", "requests", labels=("route",))
+>>> reqs.labels(route="/api").inc()
+>>> with telemetry.span("work", phase="demo"):
+...     pass  # no-op unless telemetry.tracing.enable() ran
+"""
+
+from veles_tpu.telemetry import registry, tracing  # noqa: F401
+from veles_tpu.telemetry.registry import (Counter, Gauge, Histogram,  # noqa: F401,E501
+                                          MetricsRegistry, Reservoir,
+                                          get_registry, percentile)
+from veles_tpu.telemetry.tracing import (TraceBuffer, add_complete,  # noqa: F401,E501
+                                         get_buffer, request_span, span,
+                                         trace_context)

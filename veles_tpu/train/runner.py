@@ -33,6 +33,8 @@ from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
 from veles_tpu.logger import Logger
 from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
 from veles_tpu.plumbing import Repeater, StartPoint, EndPoint
+from veles_tpu.telemetry import tracing
+from veles_tpu.telemetry.registry import get_registry
 from veles_tpu.train.step import FusedTrainer
 
 #: view groups whose units are epoch-boundary services — safe to fire
@@ -118,6 +120,24 @@ class FusedRunner(Logger):
         self.trainer = trainer if trainer is not None \
             else FusedTrainer(workflow)
         self._last_batch = (0.0, 0.0)
+        # per-epoch granularity: one observe per sweep, negligible next
+        # to the compiled segments it measures
+        registry = get_registry()
+        self._step_ms = registry.histogram(
+            "veles_step_ms", "Fused step (one class sweep) wall time",
+            labels=("phase",))
+        self._epoch_ms = registry.histogram(
+            "veles_epoch_ms", "End-to-end epoch wall time")
+
+    def _timed_step(self, phase, fn, *args, **kwargs):
+        """Run one sweep under a span + the step histogram."""
+        start = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            elapsed = time.perf_counter() - start
+            self._step_ms.labels(phase=phase).observe(elapsed * 1e3)
+            tracing.add_complete("step:%s" % phase, start, elapsed)
 
     # -- epoch bodies ------------------------------------------------------
 
@@ -341,11 +361,13 @@ class FusedRunner(Logger):
                     loader._finish_epoch()
                     loader.epoch_ended <<= False
                     loader.last_minibatch <<= False
+                epoch_start = time.perf_counter()
                 testing = bool(decision.testing)
-                stats = self._eval_classes(params, testing)
+                stats = self._timed_step("eval", self._eval_classes,
+                                         params, testing)
                 if not testing and loader.class_lengths[TRAIN]:
-                    params, states, train_stats = self._train_class(
-                        params, states)
+                    params, states, train_stats = self._timed_step(
+                        "train", self._train_class, params, states)
                     stats[TRAIN] = train_stats
                 if confusion_from_train and not testing:
                     self._feed_confusion_from_train(params)
@@ -356,6 +378,10 @@ class FusedRunner(Logger):
                     # rebind them to the live params first
                     trainer.push_params(params, states)
                 self._fire_services(services)
+                epoch_elapsed = time.perf_counter() - epoch_start
+                self._epoch_ms.observe(epoch_elapsed * 1e3)
+                tracing.add_complete("epoch", epoch_start, epoch_elapsed,
+                                     index=epochs_done)
                 epochs_done += 1
                 samples_done += sum(s["samples"] for s in stats.values())
         finally:

@@ -19,6 +19,7 @@ import numpy
 
 from veles_tpu.loader.base import TRAIN
 from veles_tpu.logger import Logger
+from veles_tpu.telemetry import tracing
 from veles_tpu.train.runner import fused_compatible
 from veles_tpu.train.step import FusedTrainer
 
@@ -57,9 +58,11 @@ class SegmentExecutor(Logger):
 
     def execute(self, job):
         """job dict -> update list (``[(unit_name, payload)]``)."""
-        if self.eager:
-            return self._execute_eager(job)
-        return self._execute_fused(job)
+        with tracing.span("step:segment", batches=len(job["batches"]),
+                          mode="eager" if self.eager else "fused"):
+            if self.eager:
+                return self._execute_eager(job)
+            return self._execute_fused(job)
 
     # -- fused path --------------------------------------------------------
 

@@ -28,7 +28,21 @@ import weakref
 from veles_tpu.config import root
 from veles_tpu.distributable import Distributable, IDistributable  # noqa: F401
 from veles_tpu.mutable import Bool, link, unlink
+from veles_tpu.telemetry import tracing
+from veles_tpu.telemetry.registry import get_registry
 from veles_tpu.unit_registry import UnitRegistry
+
+_unit_run_ms = None
+
+
+def _unit_run_hist():
+    """Lazy: most processes never flip ``timings`` or enable tracing."""
+    global _unit_run_ms
+    if _unit_run_ms is None:
+        _unit_run_ms = get_registry().histogram(
+            "veles_unit_run_ms", "Per-unit run() wall time",
+            labels=("unit",))
+    return _unit_run_ms
 
 
 class IUnit(object):
@@ -227,6 +241,15 @@ class Unit(Distributable, metaclass=UnitRegistry):
             elapsed = time.perf_counter() - start
             self.run_calls += 1
             self.run_time += elapsed
+            if tracing.enabled():
+                tracing.add_complete("unit:%s" % self.name, start, elapsed,
+                                     unit=type(self).__name__)
+            if self.timings or tracing.enabled():
+                # timings routes through telemetry: the data is readable
+                # from /metrics (or the registry snapshot) at any log
+                # level; the debug line stays for backward compatibility
+                _unit_run_hist().labels(unit=self.name).observe(
+                    elapsed * 1e3)
             if self.timings:
                 self.debug("%s ran in %.3f ms", self.name, elapsed * 1e3)
             self.event("run", "end")

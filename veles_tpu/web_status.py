@@ -27,6 +27,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
+from veles_tpu.telemetry.registry import get_registry
 
 GARBAGE_TIMEOUT = 60
 
@@ -43,7 +44,8 @@ th { background: #eee; }
 <a href="/timeline.html">event timeline</a> ·
 <a href="/slaves.html">slave stats</a> ·
 <a href="/logs.html">logs</a> ·
-<a href="/frontend.html">command composer</a></p>
+<a href="/frontend.html">command composer</a> ·
+<a href="/metrics">metrics</a></p>
 <table id="wf"><thead><tr>
 <th>id</th><th>name</th><th>mode</th><th>master</th><th>uptime</th>
 <th>slaves</th><th>units</th><th>serving</th><th>stopped</th>
@@ -506,8 +508,14 @@ class _Handler(BaseHTTPRequestHandler):
             return None
 
     def do_GET(self):
+        self.server.owner.count_request(self.path)
         if self.path in ("", "/", "/status.html"):
             self._reply(_STATUS_PAGE, ctype="text/html; charset=utf-8")
+        elif self.path.startswith("/metrics.json"):
+            self._reply(get_registry().snapshot())
+        elif self.path.startswith("/metrics"):
+            self._reply(get_registry().render_prometheus(),
+                        ctype="text/plain; version=0.0.4")
         elif self.path.startswith("/logs.html"):
             self._reply(_LOGS_PAGE, ctype="text/html; charset=utf-8")
         elif self.path.startswith("/slaves.html"):
@@ -531,6 +539,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply({"error": "not found"}, code=404)
 
     def do_POST(self):
+        self.server.owner.count_request(self.path)
         data = self._body()
         if data is None:
             self._reply({"error": "bad json"}, code=400)
@@ -573,6 +582,33 @@ class WebStatusServer(Logger):
         self._server.daemon_threads = True
         self.address = self._server.server_address
         self._thread = None
+        # own telemetry: the dashboard process always exposes at least
+        # its request counter at /metrics (Prometheus text)
+        registry = get_registry()
+        self._m_requests = registry.counter(
+            "veles_webstatus_http_requests_total",
+            "Dashboard HTTP requests", labels=("path",))
+        self._m_updates = registry.counter(
+            "veles_webstatus_updates_total",
+            "Master status updates received")
+        self._m_records = registry.counter(
+            "veles_webstatus_records_total",
+            "Log/event records received", labels=("kind",))
+
+    #: the routes the handler actually serves — anything else counts as
+    #: "other": a port scanner probing random paths must not mint an
+    #: unbounded set of labeled series in a long-lived dashboard
+    KNOWN_PATHS = frozenset([
+        "/", "/status.html", "/logs.html", "/slaves.html",
+        "/frontend.html", "/workflow.html", "/timeline.html", "/catalog",
+        "/metrics", "/metrics.json", "/update", "/service", "/logs",
+        "/events"])
+
+    def count_request(self, path):
+        path = path.split("?")[0] or "/"
+        if path not in self.KNOWN_PATHS:
+            path = "other"
+        self._m_requests.labels(path=path).inc()
 
     @property
     def port(self):
@@ -597,6 +633,7 @@ class WebStatusServer(Logger):
         mid = data["id"]
         with self._lock:
             self.masters[mid] = dict(data, last_update=time.time())
+        self._m_updates.inc()
         self.debug("master %s yielded an update", mid)
 
     @staticmethod
@@ -615,12 +652,14 @@ class WebStatusServer(Logger):
         records = self._validated(records)
         with self._lock:
             self.logs.extend(records)
+        self._m_records.labels(kind="logs").inc(len(records))
 
     def receive_events(self, data):
         records = data["events"] if isinstance(data, dict) else data
         records = self._validated(records)
         with self._lock:
             self.events.extend(records)
+        self._m_records.labels(kind="events").inc(len(records))
 
     def receive_request(self, data):
         """The ``/service`` protocol (``web_status.py:197-242``)."""

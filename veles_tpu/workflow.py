@@ -18,6 +18,7 @@ import time
 from veles_tpu.config import root
 from veles_tpu.mutable import Bool
 from veles_tpu.plumbing import StartPoint, EndPoint, Repeater
+from veles_tpu.telemetry import tracing
 from veles_tpu.units import Container, Unit
 
 
@@ -185,7 +186,11 @@ class Workflow(Container):
             self._drain()
         finally:
             self.is_running = False
-            self._run_time += time.perf_counter() - start
+            elapsed = time.perf_counter() - start
+            self._run_time += elapsed
+            if tracing.enabled():
+                tracing.add_complete("workflow:%s" % self.name, start,
+                                     elapsed, units=len(self._units))
             self.event("run", "end")
 
     def _drain(self):
