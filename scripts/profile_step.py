@@ -14,7 +14,15 @@ synchronous op line ('XLA Ops', exclusive durations) three ways:
   ``bytes_accessed`` stats — the direct test of the bandwidth-floor
   claim in docs/PERF.md.
 
-Usage: python scripts/profile_step.py [trace_dir]
+Usage: python scripts/profile_step.py [trace_dir] [--tune] [--reuse]
+
+``--tune`` first runs the kernel autotuner's search over the flagship
+GEMM shapes (scripts/gemm_bench.py's shape list) so the traced step
+runs with tuned dispatch — the before/after pair for docs/PERF.md is
+``profile_step.py`` (before) vs ``profile_step.py --tune`` (after, or
+any run with a warm cache). Every run ends with an autotune report:
+mode, cache path, hit/miss counters and the entries consulted.
+
 Env: VELES_PROFILE_SEGMENTS (default 2) — segments inside the trace.
 """
 
@@ -182,9 +190,39 @@ def _source_bucket(rec):
     return "<no source: %s>" % cat
 
 
+def autotune_report():
+    """The tuner's end-of-run accounting (report mode — printed by
+    every profile run so before/after MFU evidence carries its
+    dispatch provenance)."""
+    from veles_tpu.ops import autotune
+    s = autotune.summary()
+    print()
+    print("autotune: mode=%s device=%s searches=%d hits=%d misses=%d"
+          % (s["mode"], s["device"], s["searches"], s["hits"],
+             s["misses"]))
+    print("cache %s: %d entries" % (s["path"], len(s["entries"])))
+    for key, entry in sorted(s["entries"].items()):
+        print("  %s -> %s %s" % (key, entry.get("impl"),
+                                 entry.get("config") or ""))
+
+
 def main():
-    args = [a for a in sys.argv[1:] if a != "--reuse"]
+    args = [a for a in sys.argv[1:]
+            if a not in ("--reuse", "--tune")]
     reuse = "--reuse" in sys.argv
+    if "--tune" in sys.argv:
+        sys.path.insert(0, os.path.join(HERE, "scripts"))
+        import gemm_bench
+        import jax.numpy as jnp
+        from veles_tpu.nn.precision import POLICIES
+        os.environ.setdefault("VELES_AUTOTUNE", "search")
+        # search with the policy's exact (compute, keep-or-accum)
+        # dtype pair — the runtime linear_plan keys use both
+        pol = POLICIES[PRECISION]
+        gemm_bench.autotune_main(
+            dtype=str(jnp.dtype(pol.compute_dtype)), batch=BATCH,
+            out_dtype=str(jnp.dtype(pol.keep_dtype or
+                                    pol.accum_dtype)))
     trace_dir = (args[0] if args
                  else os.path.join("/tmp", "veles_profile_%d"
                                    % os.getpid()))
@@ -226,6 +264,8 @@ def main():
         print("| %s | %.2f | %.1f%% | %.0f |"
               % (src, secs * ms, 100.0 * secs / total_s,
                  byts / secs / 1e9 if secs else 0.0))
+
+    autotune_report()
 
 
 if __name__ == "__main__":

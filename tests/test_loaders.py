@@ -693,3 +693,119 @@ def test_hdfs_loader_unreachable_namenode_is_a_clear_error():
                         train_path="/x.pickle", minibatch_size=1)
     with pytest.raises(RuntimeError, match="cannot fetch"):
         _init_loader(loader)
+
+
+# -- generated-dataset disk cache (ISSUE 6 satellite) -------------------
+
+
+class TestDatasetCache(object):
+    @pytest.fixture
+    def cache_dir(self, monkeypatch, tmp_path):
+        from veles_tpu.config import root
+        monkeypatch.delenv("VELES_DATASET_CACHE", raising=False)
+        before = root.common.dirs.get("cache")
+        root.common.dirs["cache"] = str(tmp_path)
+        yield str(tmp_path)
+        root.common.dirs["cache"] = before
+
+    def test_round_trip_skips_builder(self, cache_dir):
+        from veles_tpu.loader.dataset_cache import cached_build
+        calls = []
+
+        def build():
+            calls.append(1)
+            return {"data": numpy.arange(24, dtype=numpy.float32)
+                    .reshape(2, 3, 4),
+                    "labels": numpy.arange(2, dtype=numpy.int32)}
+        first = cached_build("t", {"seed": 1}, build)
+        second = cached_build("t", {"seed": 1}, build)
+        assert len(calls) == 1
+        for k in first:
+            numpy.testing.assert_array_equal(first[k], second[k])
+            assert first[k].dtype == second[k].dtype
+
+    def test_config_change_invalidates(self, cache_dir):
+        from veles_tpu.loader.dataset_cache import cached_build
+        calls = []
+
+        def build():
+            calls.append(1)
+            return {"x": numpy.zeros(3)}
+        cached_build("t", {"seed": 1}, build)
+        cached_build("t", {"seed": 2}, build)
+        assert len(calls) == 2
+
+    def test_bfloat16_round_trip(self, cache_dir):
+        import ml_dtypes
+        from veles_tpu.loader.dataset_cache import cached_build
+
+        def build():
+            return {"data": numpy.arange(8, dtype=numpy.float32)
+                    .astype(ml_dtypes.bfloat16)}
+        first = cached_build("bf", {}, build)
+        second = cached_build("bf", {}, lambda: pytest.fail("miss"))
+        assert second["data"].dtype == ml_dtypes.bfloat16
+        numpy.testing.assert_array_equal(
+            first["data"].astype(numpy.float32),
+            second["data"].astype(numpy.float32))
+
+    def test_corrupt_cache_regenerates(self, cache_dir):
+        from veles_tpu.loader import dataset_cache as dc
+        calls = []
+
+        def build():
+            calls.append(1)
+            return {"x": numpy.ones(4, dtype=numpy.float64)}
+        dc.cached_build("t", {"v": 1}, build)
+        path = dc._dataset_dir("t", {"v": 1})
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            f.write("{broken")
+        out = dc.cached_build("t", {"v": 1}, build)
+        assert len(calls) == 2
+        numpy.testing.assert_array_equal(out["x"], numpy.ones(4))
+        # the store self-healed: next consult is a hit again
+        dc.cached_build("t", {"v": 1},
+                        lambda: pytest.fail("should be healed"))
+
+    def test_orphaned_staging_dir_is_swept(self, cache_dir):
+        """A .tmp-<pid> dir left by a crashed writer (dead pid) is
+        removed on the next store; one owned by a live pid is kept."""
+        from veles_tpu.loader import dataset_cache as dc
+        path = dc._dataset_dir("t", {"v": 1})
+        base = os.path.dirname(path)
+        dead = os.path.join(base, "t-feedbeef.tmp-999999999")
+        live = os.path.join(base, "t-feedbeef.tmp-%d" % os.getpid())
+        os.makedirs(dead)
+        os.makedirs(live)
+        dc.cached_build("t", {"v": 1},
+                        lambda: {"x": numpy.zeros(2)})
+        assert not os.path.isdir(dead)
+        assert os.path.isdir(live)
+
+    def test_disabled_env_knob(self, cache_dir, monkeypatch):
+        from veles_tpu.loader.dataset_cache import cached_build
+        monkeypatch.setenv("VELES_DATASET_CACHE", "0")
+        calls = []
+
+        def build():
+            calls.append(1)
+            return {"x": numpy.zeros(2)}
+        cached_build("t", {"k": 1}, build)
+        cached_build("t", {"k": 1}, build)
+        assert len(calls) == 2
+
+    def test_synthetic_loader_uses_cache(self, cache_dir):
+        from veles_tpu.models.alexnet import SyntheticImageLoader
+        kwargs = dict(n_train=8, n_valid=4, side=9, n_classes=5,
+                      minibatch_size=4, dtype="float32")
+        l1 = _init_loader(SyntheticImageLoader(DummyWorkflow(),
+                                               **kwargs))
+        l2 = _init_loader(SyntheticImageLoader(DummyWorkflow(),
+                                               **kwargs))
+        numpy.testing.assert_array_equal(l1.original_data.mem,
+                                         l2.original_data.mem)
+        from veles_tpu.loader import dataset_cache as dc
+        assert os.path.isdir(dc._dataset_dir(
+            "synthetic-image",
+            {"n_train": 8, "n_valid": 4, "side": 9, "channels": 3,
+             "n_classes": 5, "seed": 1, "dtype": "float32"}))

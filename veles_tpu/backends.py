@@ -138,6 +138,19 @@ class Device(Logger, metaclass=BackendRegistry):
         return "<%s backend=%s>" % (type(self).__name__, self.BACKEND)
 
 
+def veles_cache_dir(*parts):
+    """``~/.veles_tpu/cache/<parts...>`` (or the configured cache
+    root), created on demand — ONE home for every persistent cache:
+    the XLA compile cache, the kernel-autotune database
+    (:mod:`veles_tpu.ops.autotune`) and the generated-dataset cache
+    (:mod:`veles_tpu.loader.dataset_cache`)."""
+    base = root.common.dirs.get("cache", os.path.join(
+        os.path.expanduser("~"), ".veles_tpu", "cache"))
+    path = os.path.join(base, *parts)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
 def _cache_namespace():
     """Per-platform/per-host cache subdirectory name.
 
@@ -178,9 +191,7 @@ def _enable_persistent_compile_cache():
         return  # user/installation already configured one
     import os
     try:
-        cache_dir = os.path.join(root.common.dirs.get("cache", "."),
-                                 "xla", _cache_namespace())
-        os.makedirs(cache_dir, exist_ok=True)
+        cache_dir = veles_cache_dir("xla", _cache_namespace())
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         # also persist XLA-internal (autotune) caches where supported

@@ -76,6 +76,24 @@ class SyntheticImageLoader(FullBatchLoader):
     def load_dataset(self):
         (n_train, n_valid, side, channels, n_classes, seed,
          dtype) = self._gen
+        # generation is deterministic from self._gen, so the arrays are
+        # disk-cached keyed by it (the 86-107 s bench "loader init
+        # (generation)" phase collapses to a read on warm runs;
+        # VELES_DATASET_CACHE=0 restores always-generate)
+        from veles_tpu.loader.dataset_cache import cached_build
+        arrays = cached_build(
+            "synthetic-image",
+            {"n_train": n_train, "n_valid": n_valid, "side": side,
+             "channels": channels, "n_classes": n_classes,
+             "seed": seed, "dtype": dtype},
+            self._generate)
+        self.original_data.reset(arrays["data"])
+        self.original_labels.reset(arrays["labels"])
+        self.class_lengths = [0, n_valid, n_train]
+
+    def _generate(self):
+        (n_train, n_valid, side, channels, n_classes, seed,
+         dtype) = self._gen
         if dtype == "bfloat16":
             import ml_dtypes
             np_dtype = ml_dtypes.bfloat16
@@ -90,9 +108,7 @@ class SyntheticImageLoader(FullBatchLoader):
                 stop - start, side, side, channels).astype(
                 numpy.float32) * 2 - 1).astype(np_dtype)
         labels = rng.randint(0, n_classes, total).astype(numpy.int32)
-        self.original_data.reset(data)
-        self.original_labels.reset(labels)
-        self.class_lengths = [0, n_valid, n_train]
+        return {"data": data, "labels": labels}
 
 
 class AlexNetWorkflow(StandardWorkflow):

@@ -43,7 +43,10 @@ def lrn(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
     LOSES 22% end-to-end in the AlexNet fused step (9,660 -> 7,526
     samples/s, docs/PERF.md r3 ablation), because an opaque kernel cuts
     the fusion graph XLA otherwise builds around the LRN. Set
-    ``VELES_LRN=pallas`` to re-run that ablation."""
+    ``VELES_LRN=pallas`` to re-run that ablation — the kernels' row
+    blocking is now shape-tuned through the autotune cache
+    (``lrn_fwd``/``lrn_bwd`` entries), so re-runs of the ablation pick
+    each shape's measured best block instead of the fixed 512."""
     import os
     force = os.environ.get("VELES_LRN", "xla")
     on_tpu = jax.default_backend() == "tpu"

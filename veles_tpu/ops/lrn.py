@@ -78,10 +78,13 @@ def _bwd_kernel(x_ref, g_ref, dx_ref, *, k, alpha, beta, n):
         dx_ref.dtype)
 
 
-#: rows per grid step. The window never crosses rows (channels-only),
-#: so ANY row tiling is halo-free; 512 rows keep the kernel's f32
-#: working set well under the 16 MB scoped-VMEM budget even at C=256
-#: (a per-sample 55x55x96 block + temporaries blew it)
+#: rows per grid step, the untuned default. The window never crosses
+#: rows (channels-only), so ANY row tiling is halo-free; 512 rows keep
+#: the kernel's f32 working set well under the 16 MB scoped-VMEM budget
+#: even at C=256 (a per-sample 55x55x96 block + temporaries blew it).
+#: The autotuner (:mod:`veles_tpu.ops.autotune`, op ``lrn_fwd``/
+#: ``lrn_bwd``) searches alternatives per (rows, C, dtype) and its
+#: cached winner overrides this constant at dispatch.
 _BLOCK_ROWS = 512
 
 
@@ -90,31 +93,45 @@ def _row_view(x):
     return x.reshape(-1, x.shape[-1])
 
 
-def _row_spec(channels):
-    return pl.BlockSpec((_BLOCK_ROWS, channels), lambda i: (i, 0),
+def _row_spec(channels, block_rows):
+    return pl.BlockSpec((block_rows, channels), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
 
 
-def _call_fwd(x, k, alpha, beta, n, interpret):
+def _tuned_block_rows(rows, channels, dtype, which, block_rows):
+    if block_rows is not None:
+        return block_rows
+    from veles_tpu.ops import autotune
+    impl, cfg = autotune.lrn_plan(rows, channels, str(dtype), which)
+    if impl == "pallas" and cfg:
+        return int(cfg["block_rows"])
+    return _BLOCK_ROWS
+
+
+def _call_fwd(x, k, alpha, beta, n, interpret, block_rows=None):
     rows = _row_view(x)
-    spec = _row_spec(rows.shape[-1])
+    block_rows = _tuned_block_rows(rows.shape[0], rows.shape[-1],
+                                   x.dtype, "fwd", block_rows)
+    spec = _row_spec(rows.shape[-1], block_rows)
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, k=k, alpha=alpha, beta=beta, n=n),
         out_shape=jax.ShapeDtypeStruct(rows.shape, x.dtype),
-        grid=(pl.cdiv(rows.shape[0], _BLOCK_ROWS),),
+        grid=(pl.cdiv(rows.shape[0], block_rows),),
         in_specs=[spec], out_specs=spec,
         interpret=interpret,
     )(rows)
     return out.reshape(x.shape)
 
 
-def _call_bwd(x, g, k, alpha, beta, n, interpret):
+def _call_bwd(x, g, k, alpha, beta, n, interpret, block_rows=None):
     rows, grows = _row_view(x), _row_view(g)
-    spec = _row_spec(rows.shape[-1])
+    block_rows = _tuned_block_rows(rows.shape[0], rows.shape[-1],
+                                   x.dtype, "bwd", block_rows)
+    spec = _row_spec(rows.shape[-1], block_rows)
     out = pl.pallas_call(
         functools.partial(_bwd_kernel, k=k, alpha=alpha, beta=beta, n=n),
         out_shape=jax.ShapeDtypeStruct(rows.shape, x.dtype),
-        grid=(pl.cdiv(rows.shape[0], _BLOCK_ROWS),),
+        grid=(pl.cdiv(rows.shape[0], block_rows),),
         in_specs=[spec, spec], out_specs=spec,
         interpret=interpret,
     )(rows, grows)
