@@ -1,0 +1,220 @@
+#!/usr/bin/env python3
+"""CI perf-regression gate (ISSUE 7 tentpole part 5).
+
+Compares a machine-readable perf snapshot against a committed baseline
+with per-metric tolerances, and exits non-zero when a hard-gated
+metric regresses — the mechanism that stops "the refactor that quietly
+doubled step time" from merging.
+
+Three modes::
+
+    perf_gate.py --capture SNAP.json     # run the probe, write snapshot
+    perf_gate.py SNAP.json               # compare vs scripts/perf_baseline.json
+    perf_gate.py SNAP.json --baseline F  # compare vs an explicit baseline
+    perf_gate.py --update-baseline SNAP.json   # adopt snapshot values,
+                                               # keeping each metric's policy
+
+**The probe** is a seeded, CPU-deterministic tiny training run through
+the real fused pipeline (FusedRunner + telemetry + cost attribution),
+so the snapshot carries both *quality* metrics (final loss, epochs
+completed — bit-stable across runs on one jaxlib) and *cost* metrics
+(analytic segment FLOPs from ``Compiled.cost_analysis()``, measured
+step/compile times, host RSS).
+
+**The baseline** maps each metric to a policy::
+
+    {"metrics": {"final_loss": {"value": 0.31, "tolerance": 0.25,
+                                "direction": "lower", "gate": "hard"}}}
+
+``direction`` says which way is good ("higher" = bigger is better);
+a metric regresses when it moves the BAD way by more than
+``tolerance`` (a fraction of the baseline value). ``gate: "hard"``
+fails CI; ``gate: "report"`` only prints — the wall-clock throughput
+metrics stay report-only until a TPU-attached bench round promotes
+them (shared CI runners are too noisy to gate on milliseconds).
+
+A hard metric MISSING from the snapshot also fails: a probe change
+that silently drops a gated signal must not pass by omission.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+DEFAULT_BASELINE = os.path.join(HERE, "scripts", "perf_baseline.json")
+
+#: probe geometry — small enough for seconds-long CPU CI, big enough
+#: that the loss actually moves (so a broken optimizer regresses it)
+SAMPLES = 120
+BATCH = 20
+EPOCHS = 4
+SEED = 1234
+
+
+def _probe_workflow():
+    import numpy
+
+    from veles_tpu import prng
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.models.mnist import MnistWorkflow
+
+    rng = numpy.random.RandomState(SEED)
+    x = rng.rand(SAMPLES, 6, 6).astype(numpy.float32)
+    y = (x.reshape(SAMPLES, -1).sum(1) > 18).astype(numpy.int32)
+    split = SAMPLES - 2 * BATCH
+
+    prng.get().seed(SEED)
+    prng.get("loader").seed(SEED + 1)
+    launcher = Launcher(graphics=False)
+    wf = MnistWorkflow(
+        launcher,
+        provider=lambda: (x[:split], y[:split], x[split:], y[split:]),
+        layers=(16,), minibatch_size=BATCH, learning_rate=0.1,
+        max_epochs=EPOCHS)
+    launcher.initialize()
+    t0 = time.perf_counter()
+    launcher.run()
+    wall = time.perf_counter() - t0
+    return wf, wall
+
+
+def capture():
+    """Run the probe and return the snapshot dict."""
+    from veles_tpu.telemetry import profiler
+    from veles_tpu.telemetry.registry import get_registry
+
+    wf, wall = _probe_workflow()
+    history = wf.decision.epoch_history
+    samples = sum(h["train"]["samples"] + h["validation"]["samples"]
+                  for h in history)
+    metrics = {
+        "final_loss": float(history[-1]["validation"]["normalized"]),
+        "epochs_completed": float(len(history)),
+        "samples_per_sec": samples / wall if wall > 0 else 0.0,
+    }
+    cost = profiler.get_cost_book().cost("train_segment")
+    if cost and cost.get("flops"):
+        metrics["train_segment_gflop"] = cost["flops"] / 1e9
+    step = get_registry().get("veles_step_ms")
+    if step is not None:
+        summary = {labels.get("phase"): child.summary()
+                   for labels, child in step.series()}
+        train = summary.get("train") or {}
+        if train.get("p50") is not None:
+            metrics["step_p50_ms"] = float(train["p50"])
+    phases = profiler.phase_report()
+    if phases.get("compile"):
+        metrics["compile_ms"] = float(phases["compile"])
+    rss = profiler.host_rss_bytes()
+    if rss:
+        metrics["host_rss_gb"] = rss / 2.0 ** 30
+    return {"schema": "veles-perf-snapshot/1",
+            "probe": {"samples": SAMPLES, "batch": BATCH,
+                      "epochs": EPOCHS, "seed": SEED},
+            "metrics": metrics}
+
+
+def compare(snapshot, baseline):
+    """``(failures, lines)``: hard regressions + the full report."""
+    lines = []
+    failures = []
+    snap = snapshot.get("metrics", {})
+    base = baseline.get("metrics", {})
+    for name in sorted(base):
+        policy = base[name]
+        ref = float(policy["value"])
+        tol = float(policy.get("tolerance", 0.1))
+        direction = policy.get("direction", "higher")
+        hard = policy.get("gate", "hard") == "hard"
+        tag = "hard" if hard else "report"
+        if name not in snap:
+            line = "MISSING  %-22s baseline %.4g [%s]" % (name, ref, tag)
+            if hard:
+                failures.append(line)
+            lines.append(line)
+            continue
+        new = float(snap[name])
+        if direction == "higher":
+            bound = ref * (1.0 - tol)
+            regressed = new < bound
+        else:
+            bound = ref * (1.0 + tol)
+            regressed = new > bound
+        delta = (new - ref) / ref * 100.0 if ref else 0.0
+        status = "REGRESS" if regressed else "ok"
+        line = ("%-8s %-22s %.4g vs %.4g (%+.1f%%, %s is better, "
+                "tol %.0f%%) [%s]"
+                % (status, name, new, ref, delta, direction,
+                   tol * 100.0, tag))
+        lines.append(line)
+        if regressed and hard:
+            failures.append(line)
+    for name in sorted(set(snap) - set(base)):
+        lines.append("new      %-22s %.4g (no baseline policy)"
+                     % (name, float(snap[name])))
+    return failures, lines
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("snapshot", nargs="?",
+                        help="snapshot JSON to compare (from --capture)")
+    parser.add_argument("--capture", metavar="OUT",
+                        help="run the probe and write the snapshot here")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline policy file (default %(default)s)")
+    parser.add_argument("--update-baseline", metavar="SNAP",
+                        help="rewrite the baseline's values from this "
+                             "snapshot, keeping each metric's policy")
+    args = parser.parse_args(argv)
+
+    if args.capture:
+        snapshot = capture()
+        with open(args.capture, "w") as f:
+            json.dump(snapshot, f, indent=1, sort_keys=True)
+        print("perf snapshot -> %s" % args.capture)
+        for name, value in sorted(snapshot["metrics"].items()):
+            print("  %-22s %.4g" % (name, value))
+        return 0
+
+    if args.update_baseline:
+        with open(args.update_baseline) as f:
+            snapshot = json.load(f)
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+        for name, policy in baseline["metrics"].items():
+            if name in snapshot["metrics"]:
+                policy["value"] = round(
+                    float(snapshot["metrics"][name]), 6)
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print("baseline values updated from %s -> %s"
+              % (args.update_baseline, args.baseline))
+        return 0
+
+    if not args.snapshot:
+        parser.error("need a snapshot to compare "
+                     "(or --capture / --update-baseline)")
+    with open(args.snapshot) as f:
+        snapshot = json.load(f)
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    failures, lines = compare(snapshot, baseline)
+    print("perf gate: %s vs %s" % (args.snapshot, args.baseline))
+    for line in lines:
+        print("  " + line)
+    if failures:
+        print("PERF GATE FAILED: %d hard regression(s)" % len(failures))
+        return 1
+    print("perf gate passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,17 @@ synchronous op line ('XLA Ops', exclusive durations) three ways:
   claim in docs/PERF.md.
 
 Usage: python scripts/profile_step.py [trace_dir] [--tune] [--reuse]
+                                      [--attribution]
+
+``--attribution`` skips the xplane machinery entirely and reports from
+the telemetry registry instead (ISSUE 7): drives warmed compiled
+segments, harvests ``Compiled.cost_analysis()`` through the cost book,
+and prints the per-op attribution table (analytic FLOPs/bytes,
+arithmetic intensity, measured ms, achieved TFLOP/s, roofline bound
+verdict), the step MFU, the startup-phase breakdown and a memory
+sample — the same numbers ``/profile.json`` serves live. On non-TPU
+hosts set ``VELES_PEAK_TFLOPS`` / ``VELES_HBM_GBPS`` to get MFU and
+verdicts; without peaks the table still carries the absolute numbers.
 
 ``--tune`` first runs the kernel autotuner's search over the flagship
 GEMM shapes (scripts/gemm_bench.py's shape list) so the traced step
@@ -42,6 +53,10 @@ N_TRAIN = int(os.environ.get("VELES_BENCH_NTRAIN", 2048))
 BATCH = int(os.environ.get("VELES_BENCH_BATCH", 128))
 SEGMENTS = int(os.environ.get("VELES_PROFILE_SEGMENTS", 2))
 PRECISION = os.environ.get("VELES_BENCH_PRECISION", "bfloat16")
+# flagship geometry by default; shrinkable so the CPU CI smoke can
+# drive the identical code path in seconds instead of hours
+SIDE = int(os.environ.get("VELES_BENCH_SIDE", 227))
+CLASSES = int(os.environ.get("VELES_BENCH_CLASSES", 1000))
 
 
 def build_trainer():
@@ -60,8 +75,8 @@ def build_trainer():
     wf = AlexNetWorkflow(
         DummyLauncher(),
         loader_factory=lambda w: SyntheticImageLoader(
-            w, n_train=N_TRAIN, n_valid=BATCH, side=227,
-            n_classes=1000, minibatch_size=BATCH, dtype="bfloat16"),
+            w, n_train=N_TRAIN, n_valid=BATCH, side=SIDE,
+            n_classes=CLASSES, minibatch_size=BATCH, dtype="bfloat16"),
         layers=ALEXNET_LAYERS, max_epochs=1)
     wf.initialize(device=Device(backend=None))
     return FusedTrainer(wf)
@@ -206,9 +221,81 @@ def autotune_report():
                                  entry.get("config") or ""))
 
 
+def _fmt(value, spec="%.2f", missing="-"):
+    return missing if value is None else spec % value
+
+
+def attribution_main():
+    """The registry-sourced attribution report (no xplane parsing)."""
+    import bench  # repo-root bench.py: shared warm-up discipline
+
+    from veles_tpu.telemetry import profiler
+
+    book = profiler.get_cost_book()
+    trainer = build_trainer()
+    # harvest + compile happen inside the first (warm) calls; the
+    # timed calls below then observe steady-state segments
+    params, states, idx, keys = bench.prepare_segment_run(
+        trainer, warm=2, seed=0)
+    for _ in range(SEGMENTS):
+        t0 = time.perf_counter()
+        params, states, losses, _ = trainer._train_segment(
+            params, states, idx, keys)
+        float(losses[-1])  # block: async dispatch time would be a lie
+        elapsed = time.perf_counter() - t0
+        book.observe_ms("train_segment", elapsed)
+        book.record_step_mfu("train_segment", elapsed)
+
+    report = profiler.profile_report()
+    dev = report["device"]
+    print("attribution (telemetry registry; %d batches/segment, "
+          "batch %d, %s)" % (idx.shape[0], BATCH, PRECISION))
+    print("device peaks: %s TFLOP/s, %s GB/s HBM (ridge %s FLOP/B)"
+          % (_fmt(dev["peak_tflops"], "%.1f"),
+             _fmt(dev["hbm_gbps"], "%.0f"),
+             _fmt(dev["ridge_flops_per_byte"], "%.1f")))
+    print()
+    print("| op | GFLOP | MB | FLOP/B | calls | p50 ms | "
+          "TFLOP/s | GB/s | bound |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    for row in report["ops"]:
+        print("| %s | %s | %s | %s | %d | %s | %s | %s | %s |" % (
+            row["op"],
+            _fmt(row.get("flops") and row["flops"] / 1e9, "%.2f"),
+            _fmt(row.get("bytes") and row["bytes"] / 1e6, "%.1f"),
+            _fmt(row.get("arithmetic_intensity"), "%.1f"),
+            row.get("calls") or 0,
+            _fmt(row.get("p50_ms"), "%.2f"),
+            _fmt(row.get("achieved_tflops"), "%.2f"),
+            _fmt(row.get("achieved_gbps"), "%.1f"),
+            row.get("bound", "-")))
+    print()
+    mfu = report.get("step_mfu")
+    print("step MFU: " + ("%.1f%%" % (mfu * 100.0) if mfu
+                          else "n/a (no device peak known)"))
+    print()
+    print("startup phases:")
+    phases = report["phases_ms"]
+    total = sum(phases.values())
+    for name, ms in phases.items():
+        print("  %-18s %9.1f ms  %5.1f%%"
+              % (name, ms, 100.0 * ms / total if total else 0.0))
+    print("  %-18s %9.1f ms" % ("total", total))
+    mem = report.get("memory") or {}
+    for dev_label, m in sorted((mem.get("devices") or {}).items()):
+        print("memory %s: live %.2f GB, peak %.2f GB, limit %.2f GB"
+              % (dev_label, m.get("live_bytes", 0) / 2**30,
+                 m.get("peak_bytes", 0) / 2**30,
+                 m.get("limit_bytes", 0) / 2**30))
+    if mem.get("host_rss_bytes"):
+        print("memory host RSS: %.2f GB"
+              % (mem["host_rss_bytes"] / 2**30))
+    autotune_report()
+
+
 def main():
     args = [a for a in sys.argv[1:]
-            if a not in ("--reuse", "--tune")]
+            if a not in ("--reuse", "--tune", "--attribution")]
     reuse = "--reuse" in sys.argv
     if "--tune" in sys.argv:
         sys.path.insert(0, os.path.join(HERE, "scripts"))
@@ -223,6 +310,8 @@ def main():
             dtype=str(jnp.dtype(pol.compute_dtype)), batch=BATCH,
             out_dtype=str(jnp.dtype(pol.keep_dtype or
                                     pol.accum_dtype)))
+    if "--attribution" in sys.argv:
+        return attribution_main()
     trace_dir = (args[0] if args
                  else os.path.join("/tmp", "veles_profile_%d"
                                    % os.getpid()))

@@ -9,9 +9,14 @@ Drives the REAL surfaces end-to-end, cheaply:
    and workflow spans;
 2. starts a web_status dashboard and asserts ``GET /metrics`` returns
    Prometheus text with at least one counter, and ``/metrics.json``
-   parses.
+   and ``/profile.json`` (ISSUE 7: the attribution report) parse;
+3. with ``--flight``: trains the same tiny model with a NaN injected
+   into the training data and asserts the flight recorder left a
+   loadable record naming the offending sweep (the CI smoke for the
+   black box — this mode runs INSTEAD of the default checks).
 
-Exit code 0 = both surfaces alive. Runs on CPU in a few seconds.
+Exit code 0 = the exercised surfaces are alive. Runs on CPU in a few
+seconds.
 """
 
 import json
@@ -91,12 +96,56 @@ def check_web_status():
                                     timeout=5) as resp:
             snap = json.load(resp)
         assert snap["counters"], snap
-        print("web_status /metrics OK: %d series lines" % len(counters))
+        with urllib.request.urlopen(base + "/profile.json",
+                                    timeout=5) as resp:
+            profile = json.load(resp)
+        for key in ("ops", "phases_ms", "memory", "step_mfu"):
+            assert key in profile, profile.keys()
+        print("web_status /metrics OK: %d series lines; /profile.json "
+              "OK: %d op rows, phases %s"
+              % (len(counters), len(profile["ops"]),
+                 list(profile["phases_ms"])))
     finally:
         server.stop()
 
 
+NAN_WORKFLOW = WORKFLOW.replace(
+    "return x[:60], y[:60], x[60:], y[60:]",
+    "x[5, 0, 0] = numpy.nan  # first train sweep goes non-finite\n"
+    "        return x[:60], y[:60], x[60:], y[60:]")
+
+
+def check_flight_record(tmpdir):
+    wf_path = os.path.join(tmpdir, "nan_workflow.py")
+    with open(wf_path, "w") as f:
+        f.write(NAN_WORKFLOW)
+    flight_dir = os.path.join(tmpdir, "flight")
+    env = dict(os.environ, PYTHONPATH=HERE, JAX_PLATFORMS="cpu",
+               VELES_FLIGHT_DIR=flight_dir)
+    proc = subprocess.run(
+        [sys.executable, "-m", "veles_tpu", wf_path, "-s", "7"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        timeout=600)
+    out = proc.stdout.decode(errors="replace")
+    assert proc.returncode == 0, "CLI run failed:\n" + out[-2000:]
+    records = sorted(os.listdir(flight_dir)) if \
+        os.path.isdir(flight_dir) else []
+    jsons = [r for r in records if r.endswith(".json")]
+    assert jsons, "no flight record written; run output:\n" + out[-2000:]
+    from veles_tpu.telemetry import flight
+    record = flight.load_record(os.path.join(flight_dir, jsons[0]))
+    assert record["reason"].startswith("non_finite"), record["reason"]
+    assert "step" in record["context"], record["context"]
+    print("flight record OK: %s (%s) naming %r"
+          % (jsons[0], record["reason"], record["context"]["step"]))
+
+
 def main():
+    if "--flight" in sys.argv:
+        with tempfile.TemporaryDirectory() as tmpdir:
+            check_flight_record(tmpdir)
+        print("flight-recorder smoke PASSED")
+        return 0
     with tempfile.TemporaryDirectory() as tmpdir:
         check_trace(tmpdir)
     check_web_status()

@@ -73,3 +73,22 @@ def _test_watchdog():
             pytest.fail("test exceeded the %.0fs watchdog" % _TEST_TIMEOUT)
     finally:
         timer.cancel()
+
+
+# -- telemetry singleton isolation ------------------------------------------
+#
+# The profiler layer owns process-singleton daemon threads (the flight
+# recorder's stall watchdog, the HBM/RSS sampler). Tests that touched
+# them must not leak live threads into interpreter shutdown — the
+# C++ runtimes under jax/zmq tear down their own state at exit, and a
+# watcher thread still polling through that window intermittently
+# dies with "terminate called without an active exception". Joining
+# the threads (and detaching the recorder's root-logger handler)
+# before pytest exits removes the window.
+
+@pytest.fixture(autouse=True, scope="session")
+def _stop_telemetry_threads():
+    yield
+    from veles_tpu.telemetry import flight, profiler
+    flight.reset_recorder()
+    profiler.stop_memory_sampler()

@@ -143,3 +143,21 @@ def test_s2d_dataset_staging_exact():
         numpy.testing.assert_allclose(
             a["train"]["normalized"], b["train"]["normalized"],
             rtol=0, atol=1e-6)
+
+
+def test_donation_defaults_off_on_cpu(monkeypatch):
+    """The eager-vs-fused flake's root cause: donating scan-carried
+    params on this jaxlib's CPU client intermittently corrupts the
+    glibc heap (free(): invalid next size / segfaults / garbled
+    weights, allocator-layout dependent). Donation must stay an
+    accelerator-only optimization unless explicitly forced."""
+    monkeypatch.delenv("VELES_DONATE", raising=False)
+    assert FusedTrainer._resolve_donate(None) is False  # CPU backend
+    # explicit argument always wins
+    assert FusedTrainer._resolve_donate(True) is True
+    assert FusedTrainer._resolve_donate(False) is False
+    # env overrides the platform default both ways
+    monkeypatch.setenv("VELES_DONATE", "1")
+    assert FusedTrainer._resolve_donate(None) is True
+    monkeypatch.setenv("VELES_DONATE", "0")
+    assert FusedTrainer._resolve_donate(None) is False

@@ -1,0 +1,421 @@
+"""Performance attribution + flight recorder + perf gate (ISSUE 7).
+
+The math is pinned against hand-computed values: a GEMM whose FLOPs
+are known exactly (2·M·N·K from XLA's cost model), roofline verdicts
+around an env-forced ridge point, MFU from a synthetic cost/time pair.
+The flight recorder's detectors are driven with injected NaN losses,
+a gradient-norm spike, and a stalled sweep; every record they write
+must be loadable JSON naming the offending step. The perf gate's
+pass/fail/tolerance semantics run against in-memory baselines."""
+
+import json
+import os
+import sys
+import time
+
+import numpy
+import pytest
+
+from veles_tpu.telemetry import flight, profiler, tracing
+from veles_tpu.telemetry.registry import MetricsRegistry
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+@pytest.fixture
+def peaks(monkeypatch):
+    """Known device roofline: 1 TFLOP/s, 100 GB/s => ridge 10 FLOP/B."""
+    monkeypatch.setenv("VELES_PEAK_TFLOPS", "1")
+    monkeypatch.setenv("VELES_HBM_GBPS", "100")
+    profiler.reset_cost_book()
+    yield 1e12, 100e9
+    profiler.reset_cost_book()
+
+
+@pytest.fixture
+def fresh_book():
+    profiler.reset_cost_book()
+    yield profiler.get_cost_book()
+    profiler.reset_cost_book()
+
+
+# -- cost attribution --------------------------------------------------------
+
+
+def test_gemm_cost_analysis_hand_computed(fresh_book):
+    """XLA's cost model must report exactly 2·M·N·K FLOPs for a GEMM
+    (the hand-computable anchor for every derived number)."""
+    import jax
+
+    M, K, N = 64, 32, 16
+    fn = jax.jit(lambda a, b: a @ b)
+    a = numpy.zeros((M, K), numpy.float32)
+    b = numpy.zeros((K, N), numpy.float32)
+    cost = profiler.harvest_cost_analysis(fn.lower(a, b).compile())
+    assert cost is not None
+    assert cost["flops"] == 2 * M * N * K
+    # operands + result at least touch their own bytes once
+    assert cost["bytes"] >= 4 * (M * K + K * N + M * N)
+
+
+def test_costbook_harvest_and_report(fresh_book, peaks):
+    """harvest() populates gauges + report rows for a jitted fn."""
+    import jax
+
+    book = fresh_book
+    a = numpy.zeros((64, 32), numpy.float32)
+    b = numpy.zeros((32, 16), numpy.float32)
+    fn = jax.jit(lambda a, b: a @ b)
+    assert book.needs_harvest("gemm")
+    book.harvest("gemm", fn, (a, b))
+    assert not book.needs_harvest("gemm")  # once per op
+    assert book.cost("gemm")["flops"] == 2 * 64 * 32 * 16
+    book.observe_ms("gemm", 0.001)
+    rows = {r["op"]: r for r in book.report()["ops"]}
+    assert rows["gemm"]["calls"] == 1
+    assert rows["gemm"]["p50_ms"] == pytest.approx(1.0)
+
+
+def test_report_roofline_math(fresh_book, peaks):
+    """Achieved TFLOP/s, arithmetic intensity and the bound verdict
+    from hand-computed numbers on a known roofline."""
+    peak_flops, peak_bw = peaks
+    book = fresh_book
+    # op A: 1 GFLOP over 50 MB -> AI=20 FLOP/B >= ridge 10 -> compute
+    book.note_cost("opA", 1e9, 5e7)
+    book.observe_ms("opA", 0.002)  # 2ms -> 0.5 TFLOP/s, 50% util
+    # op B: 1 MFLOP over 1 MB -> AI=1 < 10 -> memory bound
+    book.note_cost("opB", 1e6, 1e6)
+    book.observe_ms("opB", 0.001)
+    report = book.report()
+    assert report["device"]["ridge_flops_per_byte"] == pytest.approx(10.0)
+    rows = {r["op"]: r for r in report["ops"]}
+    assert rows["opA"]["arithmetic_intensity"] == pytest.approx(20.0)
+    assert rows["opA"]["bound"] == "compute"
+    assert rows["opA"]["achieved_tflops"] == pytest.approx(0.5)
+    assert rows["opA"]["utilization"] == pytest.approx(0.5)
+    assert rows["opB"]["bound"] == "memory"
+    assert rows["opB"]["achieved_gbps"] == pytest.approx(1.0)
+
+
+def test_step_mfu(fresh_book, peaks):
+    """MFU = flops / time / peak; unknown cost or peak -> None."""
+    book = fresh_book
+    book.note_cost("train_segment", 5e9, 1e9)
+    # 5 GFLOP in 10 ms on a 1 TFLOP/s device = 50% MFU
+    assert book.record_step_mfu("train_segment", 0.010) == \
+        pytest.approx(0.5)
+    assert book.report()["step_mfu"] == pytest.approx(0.5)
+    assert book.record_step_mfu("no_such_op", 0.010) is None
+
+
+def test_device_spec_unknown_without_env(monkeypatch):
+    monkeypatch.delenv("VELES_PEAK_TFLOPS", raising=False)
+    monkeypatch.delenv("VELES_HBM_GBPS", raising=False)
+    peak, bw = profiler.device_spec()  # CPU backend: unknown kind
+    assert peak is None and bw is None
+
+
+
+def test_device_spec_tolerates_malformed_env(monkeypatch):
+    """A typo'd peak override must degrade to "unknown" (no MFU, no
+    verdict) — record_step_mfu runs unguarded after every train sweep,
+    so a ValueError here would kill training."""
+    monkeypatch.setenv("VELES_PEAK_TFLOPS", "abc")
+    monkeypatch.setenv("VELES_HBM_GBPS", "900")
+    assert profiler.device_spec(device=object()) == (None, 900e9)
+    monkeypatch.setenv("VELES_HBM_GBPS", "-5")
+    assert profiler.device_spec(device=object()) == (None, None)
+
+
+def test_memory_sampler_tolerates_malformed_env(monkeypatch):
+    """An unparsable VELES_MEMORY_SAMPLE_S disables sampling instead
+    of aborting the CLI entrypoints at startup."""
+    monkeypatch.setenv("VELES_MEMORY_SAMPLE_S", "fast")
+    assert profiler.start_memory_sampler() is None
+    monkeypatch.setenv("VELES_MEMORY_SAMPLE_S", "0")
+    assert profiler.start_memory_sampler() is None
+
+
+def test_timed_op_records(fresh_book):
+    with profiler.timed_op("tick", book=fresh_book):
+        time.sleep(0.01)
+    rows = {r["op"]: r for r in fresh_book.report()["ops"]}
+    assert rows["tick"]["p50_ms"] >= 10.0
+
+
+# -- startup phases ----------------------------------------------------------
+
+
+def test_phases_accumulate_and_order():
+    profiler.reset_phases()
+    with profiler.phase("compile"):
+        time.sleep(0.01)
+    with profiler.phase("compile"):
+        time.sleep(0.01)
+    profiler.record_phase("dataset_load", 0.5)
+    profiler.record_phase("zcustom", 0.1)
+    report = profiler.phase_report()
+    # canonical order first, extras appended
+    assert list(report) == ["dataset_load", "compile", "zcustom"]
+    assert report["compile"] >= 20.0       # two sleeps ACCUMULATE
+    assert report["dataset_load"] == pytest.approx(500.0)
+    profiler.reset_phases()
+
+
+# -- memory ------------------------------------------------------------------
+
+
+def test_memory_sample_host_rss():
+    sample = profiler.sample_memory(MetricsRegistry())
+    # CPU devices expose no memory_stats; host RSS is always there
+    assert sample["host_rss_bytes"] > 0
+
+
+def test_profile_report_shape(fresh_book):
+    report = profiler.profile_report()
+    for key in ("ops", "device", "step_mfu", "phases_ms", "memory",
+                "flight_record"):
+        assert key in report
+    json.dumps(report)  # must be wire-clean as-is
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    rec = flight.FlightRecorder(out_dir=str(tmp_path),
+                                min_dump_interval_s=0.0)
+    yield rec
+    rec.stop()
+
+
+def test_nan_loss_trips_and_names_step(recorder):
+    losses = numpy.array([0.5, 0.4, numpy.nan, 0.3])
+    path = recorder.check_losses(losses, epoch=7, phase="train")
+    assert path is not None and os.path.exists(path)
+    record = flight.load_record(path)
+    assert record["reason"] == "non_finite_loss"
+    assert record["context"]["batch"] == 2
+    assert "epoch 7 batch 2" in record["context"]["step"]
+    # clean losses do not trip
+    assert recorder.check_losses(numpy.ones(4), epoch=8) is None
+
+
+def test_nan_dumps_are_rate_limited(tmp_path):
+    rec = flight.FlightRecorder(out_dir=str(tmp_path),
+                                min_dump_interval_s=3600.0)
+    try:
+        bad = numpy.array([numpy.inf])
+        assert rec.check_losses(bad, epoch=0) is not None
+        assert rec.check_losses(bad, epoch=1) is None  # suppressed
+    finally:
+        rec.stop()
+
+
+def test_grad_norm_divergence(recorder):
+    recorder.observe_grad_norms(numpy.full(40, 1.0), epoch=0)
+    path = recorder.observe_grad_norms(
+        numpy.array([1.0, 1.0, 1000.0]), epoch=1)
+    assert path is not None
+    record = flight.load_record(path)
+    assert record["reason"] == "grad_norm_divergence"
+    assert record["context"]["batch"] == 2
+    assert record["context"]["norm"] == pytest.approx(1000.0)
+
+
+def test_grad_norm_non_finite(recorder):
+    path = recorder.observe_grad_norms(
+        numpy.array([1.0, numpy.nan]), epoch=3)
+    record = flight.load_record(path)
+    assert record["reason"] == "non_finite_grad_norm"
+    assert record["context"]["batch"] == 1
+
+
+def test_grad_norm_needs_history(recorder):
+    """A big first batch is a cold start, not a divergence."""
+    assert recorder.observe_grad_norms(
+        numpy.array([1e6]), epoch=0) is None
+
+
+def test_stall_watchdog_fires_with_stacks(tmp_path):
+    rec = flight.FlightRecorder(
+        out_dir=str(tmp_path), stall_factor=1.0, stall_min_s=0.05,
+        poll_s=0.02, min_dump_interval_s=0.0)
+    try:
+        for _ in range(4):  # build the rolling p95
+            rec.observe_step("train", 0.01)
+        rec.step_begin("train sweep epoch 1")
+        deadline = time.time() + 5.0
+        while rec.last_record_path() is None and time.time() < deadline:
+            time.sleep(0.02)
+        path = rec.last_record_path()
+        assert path is not None, "watchdog never fired"
+        record = flight.load_record(path)
+        assert record["reason"] == "stall"
+        assert record["context"]["step"] == "train sweep epoch 1"
+        # the all-thread stack dump was written FIRST, next door
+        assert record["stacks_file"] and os.path.exists(
+            record["stacks_file"])
+        with open(record["stacks_file"]) as f:
+            assert "Thread" in f.read()
+    finally:
+        rec.stop()
+
+
+def test_stall_watchdog_silent_on_completion(tmp_path):
+    rec = flight.FlightRecorder(
+        out_dir=str(tmp_path), stall_factor=10.0, stall_min_s=10.0,
+        poll_s=0.02, min_dump_interval_s=0.0)
+    try:
+        for _ in range(4):
+            rec.observe_step("train", 0.01)
+        rec.step_begin("train sweep")
+        rec.step_end()  # completed inside budget
+        time.sleep(0.1)
+        assert rec.last_record_path() is None
+    finally:
+        rec.stop()
+
+
+def test_record_embeds_ring_and_logs(recorder):
+    import logging
+    recorder.observe_step("train", 0.25, loss=1.5, epoch=2)
+    logging.getLogger("probe").error("the probe line")
+    path = recorder.record_exception(ValueError("boom"), step="epoch 2")
+    record = flight.load_record(path)
+    assert record["context"]["exception"] == "ValueError"
+    notes = [n for n in record["notes"] if n["kind"] == "step"]
+    assert notes and notes[-1]["ms"] == pytest.approx(250.0)
+    assert any("the probe line" in line["message"]
+               for line in record["log_tail"])
+
+
+def test_load_record_rejects_garbage(tmp_path):
+    bad = tmp_path / "not_a_record.json"
+    bad.write_text(json.dumps({"hello": 1}))
+    with pytest.raises(ValueError):
+        flight.load_record(str(bad))
+
+
+def test_injected_nan_run_writes_flight_record(tmp_path, monkeypatch):
+    """End-to-end: a training run whose data carries a NaN must leave
+    a flight record naming the offending sweep (the acceptance-
+    criterion path, in-process)."""
+    from veles_tpu import prng
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.models.mnist import MnistWorkflow
+
+    monkeypatch.setenv("VELES_FLIGHT_DIR", str(tmp_path))
+    flight.reset_recorder()
+    rng = numpy.random.RandomState(0)
+    x = rng.rand(80, 6, 6).astype(numpy.float32)
+    y = (x.reshape(80, -1).sum(1) > 18).astype(numpy.int32)
+    x[5, 0, 0] = numpy.nan  # train sample 5: first sweep goes NaN
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    launcher = Launcher(graphics=False)
+    wf = MnistWorkflow(
+        launcher,
+        provider=lambda: (x[:60], y[:60], x[60:], y[60:]),
+        layers=(8,), minibatch_size=20, max_epochs=2)
+    launcher.initialize()
+    try:
+        launcher.run()
+        path = flight.last_record_path()
+        assert path is not None, "no flight record written"
+        record = flight.load_record(path)
+        assert record["reason"] in ("non_finite_loss",
+                                    "non_finite_grad_norm")
+        assert "batch" in record["context"]
+        assert "step" in record["context"]
+    finally:
+        flight.reset_recorder()
+
+
+# -- perf gate ---------------------------------------------------------------
+
+
+@pytest.fixture
+def perf_gate():
+    sys.path.insert(0, SCRIPTS)
+    try:
+        import perf_gate
+        yield perf_gate
+    finally:
+        sys.path.remove(SCRIPTS)
+
+
+def _snap(**metrics):
+    return {"metrics": metrics}
+
+
+def _base(**metrics):
+    return {"metrics": metrics}
+
+
+def test_gate_passes_within_tolerance(perf_gate):
+    failures, lines = perf_gate.compare(
+        _snap(loss=0.30),
+        _base(loss={"value": 0.28, "tolerance": 0.25,
+                    "direction": "lower", "gate": "hard"}))
+    assert failures == []
+
+
+def test_gate_fails_beyond_tolerance(perf_gate):
+    failures, _ = perf_gate.compare(
+        _snap(loss=0.40),
+        _base(loss={"value": 0.28, "tolerance": 0.25,
+                    "direction": "lower", "gate": "hard"}))
+    assert len(failures) == 1 and "loss" in failures[0]
+
+
+def test_gate_direction_higher(perf_gate):
+    base = _base(qps={"value": 100.0, "tolerance": 0.1,
+                      "direction": "higher", "gate": "hard"})
+    assert perf_gate.compare(_snap(qps=95.0), base)[0] == []
+    failures, _ = perf_gate.compare(_snap(qps=80.0), base)
+    assert len(failures) == 1
+
+
+def test_gate_report_only_never_fails(perf_gate):
+    failures, lines = perf_gate.compare(
+        _snap(ms=999.0),
+        _base(ms={"value": 10.0, "tolerance": 0.1,
+                  "direction": "lower", "gate": "report"}))
+    assert failures == []
+    assert any("REGRESS" in line for line in lines)
+
+
+def test_gate_missing_hard_metric_fails(perf_gate):
+    failures, _ = perf_gate.compare(
+        _snap(),
+        _base(loss={"value": 0.3, "tolerance": 0.1,
+                    "direction": "lower", "gate": "hard"}))
+    assert len(failures) == 1 and "MISSING" in failures[0]
+
+
+def test_gate_zero_tolerance_exact(perf_gate):
+    base = _base(epochs={"value": 4.0, "tolerance": 0.0,
+                         "direction": "higher", "gate": "hard"})
+    assert perf_gate.compare(_snap(epochs=4.0), base)[0] == []
+    assert len(perf_gate.compare(_snap(epochs=3.0), base)[0]) == 1
+
+
+def test_gate_head_passes_committed_regressed_fails(perf_gate,
+                                                    tmp_path):
+    """The CI contract, minus the probe run: a snapshot matching the
+    committed baseline passes; the regressed fixture rejects it."""
+    baseline = json.load(open(os.path.join(SCRIPTS,
+                                           "perf_baseline.json")))
+    snap = {"metrics": {name: policy["value"]
+                        for name, policy in
+                        baseline["metrics"].items()}}
+    assert perf_gate.compare(snap, baseline)[0] == []
+    regressed = json.load(open(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "fixtures",
+        "perf_baseline_regressed.json")))
+    failures, _ = perf_gate.compare(snap, regressed)
+    assert failures, "regressed fixture must reject a HEAD snapshot"

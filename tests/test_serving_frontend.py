@@ -208,6 +208,13 @@ def test_metrics_and_healthz_endpoints(frontend):
     status, health = _get(frontend.port, "/healthz")
     assert status == 200
     assert health["sample_shape"] == [144]
+    # /profile.json (ISSUE 7): the attribution report, serving side —
+    # the request above ran a forward, so its bucket op has a row
+    status, profile = _get(frontend.port, "/profile.json")
+    assert status == 200
+    assert {"ops", "phases_ms", "memory"} <= set(profile)
+    assert any(r["op"].startswith("serve_forward:")
+               for r in profile["ops"])
     with pytest.raises(urllib.error.HTTPError):
         urllib.request.urlopen(
             "http://127.0.0.1:%d/other" % frontend.port, timeout=5)

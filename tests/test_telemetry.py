@@ -392,3 +392,73 @@ def test_two_slave_run_produces_unified_telemetry(trace_buffer, tmp_path):
     assert isinstance(data["traceEvents"], list) and data["traceEvents"]
     for event in data["traceEvents"]:
         assert {"ph", "ts", "pid", "tid"} <= set(event)
+
+
+# -- profiler layer integration (ISSUE 7) -----------------------------------
+
+
+def test_profiler_metrics_land_in_shared_registry():
+    """The attribution layer writes through THE registry: phase gauges
+    and cost-book series must appear in the same snapshot/exposition
+    every other surface scrapes."""
+    from veles_tpu.telemetry import profiler
+
+    profiler.reset_phases()
+    profiler.reset_cost_book()
+    try:
+        profiler.record_phase("warmup", 0.2)
+        book = profiler.get_cost_book()
+        book.note_cost("t_op", 2e9, 1e9)
+        book.observe_ms("t_op", 0.004)
+        snap = get_registry().snapshot()
+        gauges = snap["gauges"]
+        phase = {tuple(sorted(s["labels"].items())): s["value"]
+                 for s in gauges["veles_phase_ms"]["series"]}
+        assert phase[(("phase", "warmup"),)] == pytest.approx(200.0)
+        flops = {s["labels"]["op"]: s["value"]
+                 for s in gauges["veles_op_flops"]["series"]}
+        assert flops["t_op"] == pytest.approx(2e9)
+        text = get_registry().render_prometheus()
+        assert 'veles_phase_ms{phase="warmup"}' in text
+        assert 'veles_op_ms_count{op="t_op"}' in text
+    finally:
+        profiler.reset_phases()
+        profiler.reset_cost_book()
+
+
+def test_phase_spans_reach_trace_buffer(trace_buffer):
+    """phase() is a span too: the cold-start stages show up on the
+    --trace-out timeline, not only as gauges."""
+    from veles_tpu.telemetry import profiler
+
+    profiler.reset_phases()
+    try:
+        with profiler.phase("autotune_load"):
+            pass
+        names = {e["name"] for e in trace_buffer.events()}
+        assert "phase:autotune_load" in names
+    finally:
+        profiler.reset_phases()
+
+
+def test_flight_recorder_counts_in_registry(tmp_path):
+    """Detector trips + written records surface as counters."""
+    import numpy
+
+    from veles_tpu.telemetry import flight
+
+    rec = flight.FlightRecorder(out_dir=str(tmp_path),
+                                min_dump_interval_s=0.0)
+    try:
+        rec.check_losses(numpy.array([numpy.nan]), epoch=0)
+        snap = get_registry().snapshot()
+        trips = {s["labels"]["detector"]: s["value"]
+                 for s in snap["counters"]
+                 ["veles_flight_detector_trips_total"]["series"]}
+        assert trips["non_finite_loss"] >= 1
+        records = {s["labels"]["reason"]: s["value"]
+                   for s in snap["counters"]
+                   ["veles_flight_records_total"]["series"]}
+        assert records["non_finite_loss"] >= 1
+    finally:
+        rec.stop()

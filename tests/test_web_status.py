@@ -265,3 +265,24 @@ def test_event_sink_feeds_timeline(server):
     finally:
         logger_mod.remove_event_sink(sink)
         sink.close()
+
+
+def test_profile_json_endpoint(server):
+    """/profile.json (ISSUE 7): the attribution report, live."""
+    from veles_tpu.telemetry import profiler
+
+    profiler.reset_phases()
+    profiler.record_phase("compile", 1.25)
+    try:
+        status, body = _get(server.address, "/profile.json")
+        assert status == 200
+        report = json.loads(body)
+        for key in ("ops", "device", "step_mfu", "phases_ms",
+                    "memory", "flight_record"):
+            assert key in report
+        assert report["phases_ms"]["compile"] == pytest.approx(1250.0)
+        # the status page links it and renders the perf panel
+        _, page = _get(server.address, "/status.html")
+        assert "/profile.json" in page and "renderPerf" in page
+    finally:
+        profiler.reset_phases()

@@ -347,7 +347,11 @@ class Main(Logger):
             from veles_tpu.serving.frontend import main as serve_main
             return serve_main(argv[1:])
         parser = self.init_parser()
-        self.args = parser.parse_args(argv)
+        # intermixed: bare k=v override positionals legally FOLLOW
+        # options (the ensemble/genetics evaluators build argv that
+        # way), which plain parse_args rejects once the optional
+        # arguments have consumed the scan position
+        self.args = parser.parse_intermixed_args(argv)
         self._ran = False
         self._run_error = None
         if self.args.version:
@@ -402,6 +406,10 @@ class Main(Logger):
                 os.remove(self.args.trace_out)
             except OSError:
                 pass
+        # periodic HBM/RSS gauges (veles_hbm_*_bytes, host RSS) for
+        # the dashboard's memory panel; VELES_MEMORY_SAMPLE_S=0 off
+        from veles_tpu.telemetry import profiler
+        profiler.start_memory_sampler()
         try:
             if self.args.optimize:
                 return self._run_optimize(module)
@@ -422,6 +430,12 @@ class Main(Logger):
                                          "mode", None) or "veles_tpu")
                 self.info("wrote %d trace events to %s", n,
                           self.args.trace_out)
+                # per-buffer HBM attribution rides along (pprof gzip;
+                # `pprof -http : FILE` or pprof.me to inspect)
+                if profiler.dump_memory_profile(
+                        self.args.trace_out + ".memprof"):
+                    self.info("wrote device memory profile to "
+                              "%s.memprof", self.args.trace_out)
 
 
 def main(argv=None):

@@ -235,9 +235,20 @@ class JaxDevice(Device):
         return self._jax_.device_put(array, self.jax_device)
 
     def get(self, array):
-        """Device → host numpy."""
+        """Device → host numpy (always a COPY).
+
+        ``numpy.asarray`` of a CPU jax.Array is a zero-copy VIEW of
+        the XLA buffer. The fused trainers donate their param buffers
+        every segment, so any such view left in a unit's ``mem``
+        between epochs dangles once XLA frees the donated storage —
+        observed as heap-reuse garbage in weight reads and "double
+        free or corruption" aborts at interpreter exit, dependent on
+        allocator layout (the order-dependent eager-vs-fused test
+        flake). A copy pins the bytes for as long as the host array
+        lives, whatever the device buffer's fate.
+        """
         import numpy
-        return numpy.asarray(array)
+        return numpy.array(array)
 
     def sync(self):
         # effects_barrier waits for all dispatched computations; the

@@ -568,6 +568,18 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                 # next status tick instead of blanking the graph view
                 # for the whole run
                 self._graph_cache = None
+        perf = {}
+        try:
+            from veles_tpu.telemetry import flight
+            from veles_tpu.telemetry.registry import get_registry
+            mfu = get_registry().get("veles_step_mfu")
+            if mfu is not None:
+                perf["mfu"] = mfu.value
+            record = flight.last_record_path()
+            if record:
+                perf["flight_record"] = record
+        except Exception:
+            pass
         return {
             "id": self.id, "log_id": self.log_id, "mode": self.mode,
             "name": wf.name if wf else None,
@@ -576,6 +588,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "slaves": slaves,
             "units": len(wf) if wf else 0,
             "stopped": self.stopped,
+            "perf": perf,
             "graph": getattr(self, "_graph_cache", None),
         }
 

@@ -133,18 +133,22 @@ def cached_build(name, config, builder):
     next process. With the cache disabled the builder always runs and
     nothing is written.
     """
+    from veles_tpu.telemetry import profiler
     if not enabled():
-        return builder()
+        with profiler.phase("dataset_generate"):
+            return builder()
     path = _dataset_dir(name, config)
     if os.path.isdir(path):
         try:
-            arrays = _load(path)
+            with profiler.phase("dataset_load"):
+                arrays = _load(path)
             _log.info("dataset cache hit: %s", path)
             return arrays
         except Exception as e:  # corrupt cache == miss, regenerate
             _log.warning("ignoring unreadable dataset cache %s (%s: %s)",
                          path, type(e).__name__, e)
-    arrays = builder()
+    with profiler.phase("dataset_generate"):
+        arrays = builder()
     try:
         _store(path, arrays)
         _log.info("dataset cache store: %s", path)

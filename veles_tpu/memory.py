@@ -144,8 +144,12 @@ class Array(object):
         """Accept an updated device array (output of a jitted step)."""
         with self._lock_:
             if self.device is None:
-                # host-only array: the "device" result is a host value
-                self.mem = numpy.asarray(new_devmem)
+                # host-only array: the "device" result is a host value.
+                # COPY, never view: ``new_devmem`` is typically a
+                # jax.Array the next donating segment call will delete
+                # under any zero-copy view (backends.JaxDevice.get has
+                # the full story) — ``mem`` must own its bytes.
+                self.mem = numpy.array(new_devmem)
                 self._state_ = CLEAN
                 return
             self._devmem_ = new_devmem

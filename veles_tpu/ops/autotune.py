@@ -252,8 +252,10 @@ def warm():
     cache file per process; returns the entry count (0 when off)."""
     if mode() == "off":
         return 0
+    from veles_tpu.telemetry import profiler
     cache = get_cache()
-    n = len(cache)  # forces the lazy disk load
+    with profiler.phase("autotune_load"):
+        n = len(cache)  # forces the lazy disk load
     if cache.path not in _warmed:
         _warmed.add(cache.path)
         import logging
@@ -395,6 +397,16 @@ def _search(op, key, candidates, runner_fn, flops, shape_label):
         if base is not None:
             entry["baseline_tflops"] = round(flops / base[2] / 1e12, 3)
         entry["best_tflops"] = round(flops / best_s / 1e12, 3)
+        # the winning candidate joins the cost book: tuned kernels get
+        # the same roofline row as the compiled segments
+        try:
+            from veles_tpu.telemetry import profiler
+            book = profiler.get_cost_book()
+            label = "autotune:%s:%s" % (op, shape_label or "?")
+            book.note_cost(label, flops, 0.0)
+            book.observe_ms(label, best_s)
+        except Exception:
+            pass
         best_gauge.labels(op=op, shape=shape_label or "?").set(
             entry["best_tflops"])
     return entry

@@ -85,10 +85,14 @@ class DataParallelTrainer(FusedTrainer):
         data_spec = (self._data_spec, self._data_spec)
         # idx_matrix: (n_batches, mb) — shard the per-step batch dim
         idx_spec = named_sharding(self.mesh, None, self.axis)
+        # outputs: params, states, losses, metrics (+ grad norms when
+        # the flight recorder's tracking is on) — everything after the
+        # params stays replicated
+        n_extra = 3 + (1 if self.track_grad_norms else 0)
         jitted = jax.jit(
             fn,
             in_shardings=(data_spec, params_spec, repl, idx_spec, repl),
-            out_shardings=(params_spec, repl, repl, repl),
+            out_shardings=(params_spec,) + (repl,) * n_extra,
             donate_argnums=(1, 2) if self.donate else ())
         if jax.process_count() == 1:
             return jitted

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from veles_tpu.parallel.compat import shard_map
+
 
 def moe_ffn(x, router_w, w_up, w_down, mesh, axis="expert",
             capacity_factor=1.25, activation=jax.nn.relu):
@@ -42,7 +44,7 @@ def moe_ffn(x, router_w, w_up, w_down, mesh, axis="expert",
                          (router_w.shape[1], axis, n_experts))
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis)),
         out_specs=P(axis), check_vma=False)
     def run(xs, rw, up, down):

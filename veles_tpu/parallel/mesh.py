@@ -96,6 +96,18 @@ def init_multihost(coordinator_address=None, num_processes=None,
     """
     if num_processes in (None, 1):
         return False
+    # the CPU backend runs multiprocess computations only through the
+    # gloo collectives plugin; without this the post-init computation
+    # dies with "Multiprocess computations aren't implemented on the
+    # CPU backend" (the loopback tests + any CPU-pod rehearsal). Set
+    # unconditionally: the flag only governs the CPU backend's
+    # collectives, so it is inert on TPU deployments — and sniffing
+    # JAX_PLATFORMS here would miss the default CPU-only host where
+    # neither the env var nor jax_platforms is set.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jaxlib: single-platform behavior unchanged
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

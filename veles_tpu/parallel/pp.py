@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy
 from jax.sharding import PartitionSpec as P
 
+from veles_tpu.parallel.compat import shard_map
+
 
 def pipeline_apply(stage_fn, stacked_params, x_microbatches, mesh,
                    axis="pipe"):
@@ -50,7 +52,7 @@ def pipeline_apply(stage_fn, stacked_params, x_microbatches, mesh,
         lambda _: P(axis), stacked_params)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(params_spec, P()), out_specs=P(),
         check_vma=False)
     def run(params, xs):
@@ -203,7 +205,7 @@ def hetero_pipeline_apply(stage_fns, stage_params, stacked, unflattens,
                 else (P(axis), batch_spec))
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=in_specs, out_specs=batch_spec,
         check_vma=False)
     def run(params, xs, *maybe_key):

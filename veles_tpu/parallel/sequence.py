@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from veles_tpu.parallel.compat import shard_map
+
 
 def _block_attention(q, k, v, q_off, k_off, scale, causal, m, l, acc):
     """One streaming-softmax update of (m, l, acc) with a new K/V block.
@@ -60,7 +62,7 @@ def ring_attention(q, k, v, mesh, axis="seq", causal=False, scale=None):
     spec = P(None, None, axis, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
     def inner(q_blk, k_blk, v_blk):
         seq_shard = q_blk.shape[2]
@@ -136,7 +138,7 @@ def ulysses_attention(q, k, v, mesh, axis="seq", causal=False,
     spec = P(None, None, axis, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
     def inner(q_blk, k_blk, v_blk):
         # (B, H, S/n, D) -> (B, H/n, S, D): split heads, gather seq

@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from veles_tpu.parallel.compat import shard_map
+
 from veles_tpu.parallel.mesh import named_sharding
 
 
@@ -71,7 +73,7 @@ def shard_map_linear(x, w_col, w_row, mesh, axis="model",
     derives from :func:`tp_param_shardings`."""
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P(), P(None, axis), P(axis, None)),
         out_specs=P(), check_vma=False)
     def block(x, wc, wr):

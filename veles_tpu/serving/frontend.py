@@ -12,6 +12,9 @@ Speaks the same request contract as
   telemetry registry (serving + any co-resident training series).
 * ``GET /metrics.json`` — the JSON metrics snapshot
   (:class:`~veles_tpu.serving.metrics.ServingMetrics`).
+* ``GET /profile.json`` — the performance-attribution report
+  (:func:`veles_tpu.telemetry.profiler.profile_report`): per-bucket
+  forward cost/roofline rows, memory sample, startup phases.
 * ``GET /healthz`` — liveness + current model name/version.
 
 A client-supplied ``X-Request-Id`` header (or the body's ``"id"``)
@@ -186,7 +189,10 @@ class ServingFrontend(Logger):
             (time.time() - t0) * 1000.0 if t0 else None)
 
     def handle_get(self, handler):
-        if handler.path.startswith("/metrics.json"):
+        if handler.path.startswith("/profile.json"):
+            from veles_tpu.telemetry import profiler
+            self._respond(handler, 200, profiler.profile_report())
+        elif handler.path.startswith("/metrics.json"):
             self._respond(handler, 200, self.metrics.snapshot())
         elif handler.path.startswith("/metrics"):
             body = get_registry().render_prometheus().encode("utf-8")
@@ -453,6 +459,8 @@ def main(argv=None):
             os.remove(args.trace_out)
         except OSError:
             pass
+    from veles_tpu.telemetry import profiler
+    profiler.start_memory_sampler()
     store = ModelStore()
     model = store.load(args.model, name=args.name)
     frontend = ServingFrontend(
@@ -476,6 +484,9 @@ def main(argv=None):
                                           process_name="serve")
             frontend.info("wrote %d trace events to %s", n,
                           args.trace_out)
+            if profiler.dump_memory_profile(args.trace_out + ".memprof"):
+                frontend.info("wrote device memory profile to %s.memprof",
+                              args.trace_out)
     return 0
 
 

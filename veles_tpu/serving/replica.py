@@ -92,11 +92,22 @@ class Replica(Logger):
 
     def warm(self):
         """Compile every batch bucket ahead of traffic."""
-        for bucket in buckets_upto(self.max_batch_size):
-            x = numpy.zeros((bucket,) + self.model.sample_shape,
-                            numpy.float32)
-            numpy.asarray(self._forward(x))  # force compile + execute
-            self.warmed_buckets.append(bucket)
+        from veles_tpu.telemetry import profiler
+        book = profiler.get_cost_book()
+        with profiler.phase("warmup"):
+            for bucket in buckets_upto(self.max_batch_size):
+                x = numpy.zeros((bucket,) + self.model.sample_shape,
+                                numpy.float32)
+                numpy.asarray(self._forward(x))  # force compile + execute
+                # cost harvest AFTER the warming call: its compile
+                # populated the persistent XLA cache, so the harvest's
+                # lower().compile() deserializes instead of paying a
+                # second full compile — and the roofline table then
+                # covers every serving bucket alongside the train
+                # segments
+                book.harvest("serve_forward:b%d" % bucket,
+                             self._forward, (x,))
+                self.warmed_buckets.append(bucket)
         self.debug("replica %d warm: %s v%d, buckets %s", self.index,
                    self.model.name, self.model.version,
                    self.warmed_buckets)
@@ -105,13 +116,15 @@ class Replica(Logger):
 
     def infer(self, batch):
         """Synchronous padded forward (runs on the worker thread)."""
+        from veles_tpu.telemetry import profiler
         rows = batch.shape[0]
         bucket = bucket_for(rows, self.max_batch_size)
         if rows < bucket:
             pad = numpy.zeros((bucket - rows,) + batch.shape[1:],
                               batch.dtype)
             batch = numpy.concatenate([batch, pad], axis=0)
-        out = numpy.asarray(self._forward(batch))
+        with profiler.timed_op("serve_forward:b%d" % bucket):
+            out = numpy.asarray(self._forward(batch))
         return out[:rows], bucket
 
     @property

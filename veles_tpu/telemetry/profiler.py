@@ -1,0 +1,530 @@
+"""Performance attribution: cost analysis, roofline, memory, phases.
+
+The PR 4 telemetry core records *how long* things take; this module
+attributes *where* the FLOPs, bytes and seconds go, so the MFU plateau
+(ROADMAP item 2) and the cold-start wall (item 4) can be chased with
+numbers instead of ablations:
+
+* **per-op cost attribution** — every jitted computation the system
+  runs (train/eval segments, serving replica forwards, autotuned
+  Pallas candidates) registers with the :class:`CostBook`, which
+  harvests XLA's ``Compiled.cost_analysis()`` (analytic FLOPs and
+  bytes-accessed of the whole executable) and pairs it with the op's
+  *measured* wall time from the registry to publish achieved FLOP/s,
+  arithmetic intensity and a compute-vs-memory-bound roofline verdict
+  against the device's peak specs (``veles_op_flops``,
+  ``veles_op_bytes``, ``veles_op_ms``);
+
+* **step MFU** — the train segment's analytic FLOPs over its measured
+  wall time, as a fraction of device peak (``veles_step_mfu``) — the
+  number BENCH rounds have been estimating indirectly;
+
+* **startup phases** — :func:`phase` marks the first-class cold-start
+  stages (``dataset_generate``, ``dataset_load``, ``autotune_load``,
+  ``compile``, ``warmup``, ``first_step``) as spans + one-shot
+  ``veles_phase_ms{phase}`` gauges, so a bench round can prove which
+  stage a cold-start fix actually killed;
+
+* **memory** — :class:`MemorySampler` periodically folds
+  ``device.memory_stats()`` (live/peak HBM per device) and the host
+  RSS into gauges; :func:`dump_memory_profile` writes
+  ``jax.profiler.device_memory_profile`` (per-buffer attribution,
+  pprof format) alongside a ``--trace-out`` dump.
+
+Everything here is advisory instrumentation: every harvest path is
+wrapped so a cost-analysis failure can never take down training, and
+``VELES_COST_ATTRIBUTION=0`` turns harvesting off entirely.
+"""
+
+import json
+import os
+import threading
+import time
+
+from veles_tpu.telemetry import tracing
+from veles_tpu.telemetry.registry import get_registry
+
+#: (peak dense TFLOP/s, HBM GB/s) per JAX ``device_kind`` prefix —
+#: public per-chip specs, bf16 peak where the hardware has one. The
+#: roofline ridge point is their ratio. Unknown kinds (CPU included)
+#: fall back to the VELES_PEAK_TFLOPS / VELES_HBM_GBPS env overrides,
+#: else attribution reports absolute numbers with MFU/verdict omitted.
+DEVICE_SPECS = (
+    ("TPU v6", (918.0, 1640.0)),
+    ("TPU v5p", (459.0, 2765.0)),
+    ("TPU v5e", (197.0, 819.0)),
+    ("TPU v5 lite", (197.0, 819.0)),
+    ("TPU v4", (275.0, 1228.0)),
+    ("TPU v3", (123.0, 900.0)),
+    ("TPU v2", (45.0, 700.0)),
+)
+
+
+def _env_positive(name):
+    """float(env) or None — a typo'd override must degrade to
+    "unknown peak" (no MFU/verdict), never unwind a training sweep."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        return None
+    return value if value > 0 else None
+
+
+def device_spec(device=None):
+    """``(peak_flops_per_s, hbm_bytes_per_s)`` for ``device`` (default:
+    the first local device), or ``(None, None)`` when unknown."""
+    tflops = _env_positive("VELES_PEAK_TFLOPS")
+    gbps = _env_positive("VELES_HBM_GBPS")
+    if tflops and gbps:
+        return tflops * 1e12, gbps * 1e9
+    kind = ""
+    try:
+        if device is None:
+            import jax
+            device = jax.local_devices()[0]
+        kind = device.device_kind
+    except Exception:
+        pass
+    for prefix, (tf, gb) in DEVICE_SPECS:
+        if kind.startswith(prefix):
+            return tf * 1e12, gb * 1e9
+    return ((tflops * 1e12 if tflops else None),
+            (gbps * 1e9 if gbps else None))
+
+
+def attribution_enabled():
+    return os.environ.get("VELES_COST_ATTRIBUTION", "1") not in (
+        "0", "off", "no")
+
+
+def _first(costs, *keys):
+    """cost_analysis() returns one dict per program; sum a key over
+    them (TPU returns a single-element list, CPU sometimes several)."""
+    if isinstance(costs, dict):
+        costs = [costs]
+    total = 0.0
+    for c in costs or ():
+        for key in keys:
+            if key in c:
+                total += float(c[key])
+                break
+    return total
+
+
+def harvest_cost_analysis(compiled):
+    """``{"flops": f, "bytes": b}`` from a ``jax.stages.Compiled`` (or
+    anything with ``cost_analysis()``); None when unavailable."""
+    try:
+        costs = compiled.cost_analysis()
+    except Exception:
+        return None
+    if not costs:
+        return None
+    return {"flops": _first(costs, "flops"),
+            "bytes": _first(costs, "bytes accessed")}
+
+
+class CostBook(object):
+    """Per-op ledger: analytic cost (harvested once per op) joined with
+    measured wall time (observed per call) and the device roofline.
+
+    ``note_cost(op, flops, bytes)`` records analytics directly (the
+    autotuner path — it computes kernel FLOPs itself);
+    ``harvest(op, jit_fn, args, kwargs)`` lowers+compiles the function
+    for its cost analysis — with the persistent XLA cache warm this is
+    cheap, and it runs at most once per op name.
+    """
+
+    def __init__(self, registry=None):
+        registry = registry or get_registry()
+        self._lock = threading.Lock()
+        self._costs = {}          # op -> {"flops", "bytes"}
+        self._harvested = set()   # op names already attempted
+        self._g_flops = registry.gauge(
+            "veles_op_flops", "Analytic FLOPs per execution of a "
+            "compiled op (XLA cost model)", labels=("op",))
+        self._g_bytes = registry.gauge(
+            "veles_op_bytes", "Analytic bytes accessed per execution "
+            "of a compiled op (XLA cost model)", labels=("op",))
+        self._h_ms = registry.histogram(
+            "veles_op_ms", "Measured wall time per compiled-op call",
+            labels=("op",))
+        self._g_mfu = registry.gauge(
+            "veles_step_mfu", "Model FLOPs utilization of the train "
+            "step (analytic FLOPs / measured time / device peak)")
+
+    # -- recording ---------------------------------------------------------
+
+    def note_cost(self, op, flops, bytes_accessed):
+        with self._lock:
+            self._costs[op] = {"flops": float(flops),
+                               "bytes": float(bytes_accessed)}
+            self._harvested.add(op)
+        self._g_flops.labels(op=op).set(flops)
+        self._g_bytes.labels(op=op).set(bytes_accessed)
+
+    def needs_harvest(self, op):
+        if not attribution_enabled():
+            return False
+        with self._lock:
+            return op not in self._harvested
+
+    def harvest(self, op, jit_fn, args, kwargs=None):
+        """Lower+compile ``jit_fn`` at ``args`` and record its cost
+        analysis under ``op``. Never raises; at most one attempt per
+        op (failures record an empty entry so they are not retried on
+        the hot path)."""
+        with self._lock:
+            if op in self._harvested:
+                return
+            self._harvested.add(op)
+        try:
+            with tracing.span("cost_harvest", op=op):
+                compiled = jit_fn.lower(*args, **(kwargs or {})).compile()
+            cost = harvest_cost_analysis(compiled)
+        except Exception:
+            cost = None
+        if cost is None:
+            return
+        with self._lock:
+            self._costs[op] = cost
+        self._g_flops.labels(op=op).set(cost["flops"])
+        self._g_bytes.labels(op=op).set(cost["bytes"])
+
+    def observe_ms(self, op, elapsed_s):
+        self._h_ms.labels(op=op).observe(elapsed_s * 1e3)
+
+    def cost(self, op):
+        with self._lock:
+            return dict(self._costs.get(op) or {}) or None
+
+    # -- derived -----------------------------------------------------------
+
+    def record_step_mfu(self, op, elapsed_s):
+        """Set ``veles_step_mfu`` from one measured execution of ``op``
+        (the train segment). Returns the MFU or None."""
+        cost = self.cost(op)
+        peak, _ = device_spec()
+        if not cost or not cost["flops"] or not peak or elapsed_s <= 0:
+            return None
+        mfu = cost["flops"] / elapsed_s / peak
+        self._g_mfu.set(mfu)
+        return mfu
+
+    def report(self):
+        """The attribution table: one row per op with analytic cost,
+        measured time (registry percentiles) and the roofline verdict.
+        JSON-able — this is what ``/profile.json`` and
+        ``profile_step.py --attribution`` render."""
+        peak_flops, peak_bw = device_spec()
+        ridge = (peak_flops / peak_bw
+                 if peak_flops and peak_bw else None)
+        with self._lock:
+            costs = {op: dict(c) for op, c in self._costs.items()}
+        measured = {}
+        for labels, child in self._h_ms.series():
+            measured[labels.get("op")] = child.summary()
+        ops = []
+        for op in sorted(set(costs) | set(measured)):
+            cost = costs.get(op) or {}
+            times = measured.get(op) or {}
+            row = {"op": op,
+                   "flops": cost.get("flops"),
+                   "bytes": cost.get("bytes"),
+                   "calls": times.get("count", 0),
+                   "p50_ms": times.get("p50"),
+                   "p95_ms": times.get("p95")}
+            flops, byts = cost.get("flops"), cost.get("bytes")
+            if flops and byts:
+                row["arithmetic_intensity"] = flops / byts
+                if ridge is not None:
+                    row["bound"] = ("compute"
+                                    if row["arithmetic_intensity"] >= ridge
+                                    else "memory")
+            p50 = times.get("p50")
+            if flops and p50:
+                row["achieved_tflops"] = flops / (p50 / 1e3) / 1e12
+                if peak_flops:
+                    row["utilization"] = (flops / (p50 / 1e3) /
+                                          peak_flops)
+            if byts and p50:
+                row["achieved_gbps"] = byts / (p50 / 1e3) / 1e9
+            ops.append(row)
+        out = {"ops": ops,
+               "device": {"peak_tflops": (peak_flops / 1e12
+                                          if peak_flops else None),
+                          "hbm_gbps": (peak_bw / 1e9
+                                       if peak_bw else None),
+                          "ridge_flops_per_byte": ridge}}
+        try:
+            out["step_mfu"] = self._g_mfu.value
+        except ValueError:  # never set this process
+            out["step_mfu"] = None
+        return out
+
+
+_book = None
+_book_lock = threading.Lock()
+
+
+def get_cost_book():
+    global _book
+    with _book_lock:
+        if _book is None:
+            _book = CostBook()
+        return _book
+
+
+def reset_cost_book():
+    """Tests only: drop the book so a fresh registry gets fresh gauges."""
+    global _book
+    with _book_lock:
+        _book = None
+
+
+class timed_op(object):
+    """Context manager timing one execution of a named op into the
+    cost book (span + ``veles_op_ms``); the cheap always-on half of
+    attribution (the harvest half is one-time)."""
+
+    __slots__ = ("op", "_start", "_book")
+
+    def __init__(self, op, book=None):
+        self.op = op
+        self._book = book or get_cost_book()
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        elapsed = time.perf_counter() - self._start
+        self._book.observe_ms(self.op, elapsed)
+        tracing.add_complete("op:%s" % self.op, self._start, elapsed)
+        return False
+
+
+# -- startup phases ----------------------------------------------------------
+
+PHASES = ("dataset_generate", "dataset_load", "autotune_load",
+          "compile", "warmup", "first_step")
+
+_phase_lock = threading.Lock()
+_phase_ms = {}  # phase -> cumulative ms this process
+
+
+class _Phase(object):
+    __slots__ = ("name", "_start")
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        elapsed = time.perf_counter() - self._start
+        record_phase(self.name, elapsed)
+        tracing.add_complete("phase:%s" % self.name, self._start,
+                             elapsed)
+        return False
+
+
+def phase(name):
+    """Span + ``veles_phase_ms{phase}`` for one startup stage. Phases
+    ACCUMULATE within a process (two datasets load = one total), which
+    is the quantity a cold-start bench wants."""
+    return _Phase(name)
+
+
+def record_phase(name, elapsed_s):
+    with _phase_lock:
+        _phase_ms[name] = _phase_ms.get(name, 0.0) + elapsed_s * 1e3
+        total = _phase_ms[name]
+    get_registry().gauge(
+        "veles_phase_ms", "Cumulative startup-phase wall time",
+        labels=("phase",)).labels(phase=name).set(total)
+
+
+def phase_report():
+    """``{phase: ms}`` in canonical order (extras appended)."""
+    with _phase_lock:
+        snap = dict(_phase_ms)
+    out = {}
+    for name in PHASES:
+        if name in snap:
+            out[name] = round(snap.pop(name), 3)
+    for name in sorted(snap):
+        out[name] = round(snap[name], 3)
+    return out
+
+
+def reset_phases():
+    """Tests only."""
+    with _phase_lock:
+        _phase_ms.clear()
+
+
+# -- memory ------------------------------------------------------------------
+
+
+def host_rss_bytes():
+    """Resident set size of this process, or None off-Linux."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def sample_memory(registry=None):
+    """One sample of per-device HBM + host RSS into gauges. Returns
+    the JSON-able sample (what ``/profile.json`` embeds)."""
+    registry = registry or get_registry()
+    g_live = registry.gauge(
+        "veles_hbm_live_bytes", "Live device memory", labels=("device",))
+    g_peak = registry.gauge(
+        "veles_hbm_peak_bytes", "Peak device memory", labels=("device",))
+    g_limit = registry.gauge(
+        "veles_hbm_limit_bytes", "Device memory capacity",
+        labels=("device",))
+    g_rss = registry.gauge("veles_host_rss_bytes", "Host process RSS")
+    sample = {"devices": {}, "host_rss_bytes": None}
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        devices = ()
+    for dev in devices:
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            stats = {}
+        if not stats:
+            continue
+        label = "%s:%d" % (dev.platform, dev.id)
+        live = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use")
+        limit = stats.get("bytes_limit")
+        entry = {}
+        if live is not None:
+            g_live.labels(device=label).set(live)
+            entry["live_bytes"] = int(live)
+        if peak is not None:
+            g_peak.labels(device=label).set(peak)
+            entry["peak_bytes"] = int(peak)
+        if limit is not None:
+            g_limit.labels(device=label).set(limit)
+            entry["limit_bytes"] = int(limit)
+        if entry:
+            sample["devices"][label] = entry
+    rss = host_rss_bytes()
+    if rss is not None:
+        g_rss.set(rss)
+        sample["host_rss_bytes"] = rss
+    return sample
+
+
+class MemorySampler(object):
+    """Daemon thread folding :func:`sample_memory` into the registry
+    every ``interval`` seconds. Start once per process; stop() is only
+    needed by tests (the thread is a daemon)."""
+
+    def __init__(self, interval=5.0, registry=None):
+        self.interval = float(interval)
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="memory-sampler")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                sample_memory(self._registry)
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_sampler = None
+
+
+def start_memory_sampler(interval=None):
+    """Process-wide sampler (idempotent). ``VELES_MEMORY_SAMPLE_S``
+    overrides the interval; 0 disables."""
+    global _sampler
+    if interval is None:
+        env = _env_positive("VELES_MEMORY_SAMPLE_S")
+        if env is None and os.environ.get(
+                "VELES_MEMORY_SAMPLE_S") is not None:
+            return None  # explicit 0 / unparsable: sampling off
+        interval = env if env is not None else 5.0
+    if interval <= 0:
+        return None
+    with _book_lock:
+        if _sampler is None:
+            _sampler = MemorySampler(interval=interval).start()
+    return _sampler
+
+
+def stop_memory_sampler():
+    """Join the process-wide sampler (tests / orderly shutdown)."""
+    global _sampler
+    with _book_lock:
+        sampler, _sampler = _sampler, None
+    if sampler is not None:
+        sampler.stop()
+
+
+def dump_memory_profile(path):
+    """Write ``jax.profiler.device_memory_profile()`` (per-buffer HBM
+    attribution, pprof gzip) to ``path``. Returns True on success —
+    callers pair this with a ``--trace-out`` dump."""
+    try:
+        import jax.profiler
+        blob = jax.profiler.device_memory_profile()
+        tmp = "%s.%d.tmp" % (path, os.getpid())
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return True
+    except Exception:
+        return False
+
+
+# -- the /profile.json payload ----------------------------------------------
+
+
+def profile_report():
+    """Everything the observability surfaces render: attribution table,
+    step MFU, startup phases, the latest memory sample, and the last
+    flight-record path (when the recorder has written one)."""
+    from veles_tpu.telemetry import flight
+    report = get_cost_book().report()
+    report["phases_ms"] = phase_report()
+    try:
+        report["memory"] = sample_memory()
+    except Exception:
+        report["memory"] = None
+    report["flight_record"] = flight.last_record_path()
+    return report
+
+
+def render_profile_json():
+    return json.dumps(profile_report())

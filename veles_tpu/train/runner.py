@@ -33,7 +33,7 @@ from veles_tpu.loader.base import TEST, TRAIN, VALIDATION
 from veles_tpu.logger import Logger
 from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
 from veles_tpu.plumbing import Repeater, StartPoint, EndPoint
-from veles_tpu.telemetry import tracing
+from veles_tpu.telemetry import flight, profiler, tracing
 from veles_tpu.telemetry.registry import get_registry
 from veles_tpu.train.step import FusedTrainer
 
@@ -128,16 +128,46 @@ class FusedRunner(Logger):
             labels=("phase",))
         self._epoch_ms = registry.histogram(
             "veles_epoch_ms", "End-to-end epoch wall time")
+        # the flight recorder (stall watchdog + NaN/divergence
+        # detectors) and the cost book (per-op ms + step MFU) ride
+        # every sweep; both are advisory and never raise into the run
+        self._flight = flight.get_recorder()
+        self._book = profiler.get_cost_book()
+        self._epoch_index = 0
+        self._first_step_done = False
 
     def _timed_step(self, phase, fn, *args, **kwargs):
-        """Run one sweep under a span + the step histogram."""
+        """Run one sweep under a span + the step histogram, with the
+        stall watchdog armed; the first TRAIN sweep (which holds the
+        train-segment compile on a cold cache — epoch order runs the
+        eval classes first, so "first sweep of the run" would record
+        the small eval sweep instead) lands in ``first_step``."""
+        self._flight.step_begin("%s sweep epoch %d"
+                                % (phase, self._epoch_index))
         start = time.perf_counter()
         try:
-            return fn(*args, **kwargs)
+            result = fn(*args, **kwargs)
+        except Exception as e:
+            self._flight.record_exception(
+                e, step="%s sweep epoch %d" % (phase,
+                                               self._epoch_index))
+            raise
         finally:
+            self._flight.step_end()
             elapsed = time.perf_counter() - start
             self._step_ms.labels(phase=phase).observe(elapsed * 1e3)
             tracing.add_complete("step:%s" % phase, start, elapsed)
+            if phase == "train" and not self._first_step_done:
+                self._first_step_done = True
+                profiler.record_phase("first_step", elapsed)
+        op = "train_segment" if phase == "train" else "eval_segment"
+        self._book.observe_ms(op, elapsed)
+        if phase == "train":
+            self._book.record_step_mfu("train_segment", elapsed)
+        self._flight.observe_step(phase, elapsed,
+                                  loss=self._last_batch[0],
+                                  epoch=self._epoch_index)
+        return result
 
     # -- epoch bodies ------------------------------------------------------
 
@@ -171,6 +201,12 @@ class FusedRunner(Logger):
             if skip:
                 stats[klass]["samples"] -= skip
             self._last_batch = (float(losses[-1]), float(metrics[-1]))
+            try:
+                self._flight.check_losses(losses,
+                                          epoch=self._epoch_index,
+                                          phase="eval")
+            except Exception:
+                pass
         return stats
 
     def _train_class(self, params, states, skip=0):
@@ -178,6 +214,17 @@ class FusedRunner(Logger):
         params, states, losses, metrics = trainer.train_class(
             params, states, skip=skip)
         self._last_batch = (float(losses[-1]), float(metrics[-1]))
+        # detectors: the whole per-batch loss vector (a NaN that heals
+        # by the last batch must still trip) + the grad-norm series
+        try:
+            self._flight.check_losses(losses, epoch=self._epoch_index,
+                                      phase="train")
+            if trainer.last_grad_norms is not None:
+                self._flight.observe_grad_norms(
+                    numpy.asarray(trainer.last_grad_norms),
+                    epoch=self._epoch_index)
+        except Exception:
+            pass  # detection is advisory, training is not
         stats = trainer._summarize(losses, metrics, TRAIN)
         if skip:
             stats["samples"] -= skip
@@ -346,6 +393,7 @@ class FusedRunner(Logger):
                     trainer.push_params(params, states)
                 self._fire_services(services)
                 epochs_done += 1
+                self._epoch_index = epochs_done
                 samples_done += sum(s["samples"] for s in stats.values())
             while True:
                 if bool(decision.complete) or bool(workflow.stopped):
@@ -383,7 +431,15 @@ class FusedRunner(Logger):
                 tracing.add_complete("epoch", epoch_start, epoch_elapsed,
                                      index=epochs_done)
                 epochs_done += 1
+                self._epoch_index = epochs_done
                 samples_done += sum(s["samples"] for s in stats.values())
+        except Exception as e:
+            # the crash path: persist the black box BEFORE the
+            # exception unwinds the run (sweep-level failures already
+            # dumped in _timed_step; the recorder rate-limits dupes)
+            self._flight.record_exception(
+                e, step="epoch %d" % self._epoch_index)
+            raise
         finally:
             # rebind unit arrays even on an exception / Ctrl-C: the
             # epochs that DID complete must survive into any subsequent

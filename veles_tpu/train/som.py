@@ -64,7 +64,9 @@ class SOMFusedRunner(Logger):
                                               idx_matrix)
             return weights, t, wins[-1]
 
-        return jax.jit(epoch, donate_argnums=(1,))
+        from veles_tpu.train.step import FusedTrainer
+        donate = FusedTrainer._resolve_donate(None)
+        return jax.jit(epoch, donate_argnums=(1,) if donate else ())
 
     def _epoch_indices(self, loader):
         """The epoch's serving order as a (n_batches, mb) matrix.

@@ -15,8 +15,11 @@ The compiled functions:
 
 * ``train_segment(params, states, idx_matrix)`` — ``lax.scan`` over
   minibatches: gather → forward → loss → grad → per-layer solver
-  update. Params/opt-states are donated, so weights stay in HBM across
-  the whole segment with zero host traffic;
+  update. On accelerators params/opt-states are donated, so weights
+  stay in HBM across the whole segment with zero host traffic; on the
+  CPU backend donation is OFF by default (``VELES_DONATE`` overrides)
+  because this jaxlib's CPU client corrupts the heap under it — see
+  :meth:`FusedTrainer._resolve_donate`;
 * ``eval_segment(params, idx_matrix)`` — forward-only scan.
 
 Epoch order mirrors the eager path (validation before train), so loss
@@ -27,6 +30,7 @@ masked — identical to EvaluatorSoftmax's ``(p - onehot)/batch`` seed
 through the GD chain.
 """
 
+import os
 import time
 
 import jax
@@ -39,26 +43,63 @@ from veles_tpu.logger import Logger
 from veles_tpu.nn.dropout import DropoutForward
 from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
 from veles_tpu.nn.optim import get_solver
+from veles_tpu.telemetry import profiler
 
 
 class FusedTrainer(Logger):
     """Compiles and drives the fused train/eval loop of a workflow."""
 
-    def __init__(self, workflow, donate=True, stage_s2d=True):
+    def __init__(self, workflow, donate=None, stage_s2d=True,
+                 grad_norms=None):
         super(FusedTrainer, self).__init__()
         self.workflow = workflow
         self.loader = workflow.loader
         self.forwards = list(workflow.forwards)
         self.evaluator = workflow.evaluator
         self.decision = workflow.decision
-        self.donate = donate
+        self.donate = self._resolve_donate(donate)
         self.stage_s2d = stage_s2d
+        # per-batch global gradient norms ride the train scan (the
+        # flight recorder's divergence detector input); the norm is a
+        # pure observation over grads the solver reads anyway, so the
+        # update math is untouched
+        self.track_grad_norms = (
+            grad_norms if grad_norms is not None
+            else os.environ.get("VELES_GRAD_NORMS", "1") not in (
+                "0", "off", "no"))
+        #: (n_batches,) f32 norms of the most recent train segment,
+        #: None until one ran (or when tracking is off)
+        self.last_grad_norms = None
         self._staged_s2d = False
         # map each forward to its GD unit (for solver + hyper)
         self.gd_for = {}
         for gd in getattr(workflow, "gds", []):
             self.gd_for[id(gd.forward)] = gd
         self._build()
+
+    @staticmethod
+    def _resolve_donate(donate):
+        """Donation policy: explicit arg > ``VELES_DONATE`` env > off
+        on CPU, on elsewhere.
+
+        Donation is an HBM-residency optimization — on TPU it keeps
+        weights device-resident across segments without a spare copy.
+        On the CPU backend it buys nothing (host RAM, no transfer) and
+        this jaxlib's CPU client intermittently corrupts the glibc
+        heap when scan-carried tuple params are donated: depending on
+        allocator layout the run dies with ``free(): invalid next
+        size`` / ``munmap_chunk(): invalid pointer`` aborts, segfaults
+        materializing segment outputs, or silently-garbled weights —
+        the long-standing "order-dependent eager-vs-fused flake"
+        (reproduced standalone: tests/test_fused_runner.py fails or
+        aborts ~5/6 runs with donation on CPU, 0/6 with it off)."""
+        if donate is not None:
+            return donate
+        env = os.environ.get("VELES_DONATE")
+        if env is not None:
+            return env not in ("0", "off", "no")
+        import jax
+        return jax.default_backend() != "cpu"
 
     # -- pure functions ----------------------------------------------------
 
@@ -181,7 +222,7 @@ class FusedTrainer(Logger):
         update = jax.jit(
             lambda buf, chunk, start: jax.lax.dynamic_update_slice(
                 buf, pack_flat(chunk), (start, 0, 0)),
-            donate_argnums=(0,))
+            donate_argnums=(0,) if self.donate else ())
         packed = jnp.zeros((n, ry, inner), dtype=raw.dtype)
         chunk = max(1, min(n, 512))
         for i, start in enumerate(range(0, n, chunk)):
@@ -281,21 +322,51 @@ class FusedTrainer(Logger):
                     self.hypers[i])
                 new_params.append(p)
                 new_states.append(s)
-            return (tuple(new_params), tuple(new_states)), (loss, metric)
+            outs = (loss, metric)
+            if track_norms:
+                # global grad norm in f32 — observation only, and the
+                # grads are being read by the solvers anyway so XLA
+                # fuses the reduction into traffic already paid for
+                gsq = jnp.asarray(0.0, jnp.float32)
+                for g in jax.tree_util.tree_leaves(grads):
+                    gsq = gsq + jnp.sum(jnp.square(
+                        g.astype(jnp.float32)))
+                outs = (loss, metric, jnp.sqrt(gsq))
+            return (tuple(new_params), tuple(new_states)), outs
+
+        track_norms = self.track_grad_norms
 
         def train_segment(data_args, params_list, opt_states, idx_matrix,
                           keys):
-            (params_list, opt_states), (losses, metrics) = jax.lax.scan(
+            (params_list, opt_states), outs = jax.lax.scan(
                 lambda carry, batch_in: train_batch(data_args, carry,
                                                     batch_in),
                 (params_list, opt_states), (idx_matrix, keys))
-            return params_list, opt_states, losses, metrics
+            return (params_list, opt_states) + tuple(outs)
 
         jit_train = self._compile_train(train_segment)
 
         def _train_segment_call(params_list, opt_states, idx_matrix, keys):
-            return jit_train(self._data_args, params_list, opt_states,
-                             idx_matrix, keys)
+            args = (self._data_args, params_list, opt_states,
+                    idx_matrix, keys)
+            # abstract shapes are snapshotted BEFORE the jitted call
+            # (it donates the params/states buffers), but the harvest
+            # compile runs AFTER it: the call populates the persistent
+            # XLA cache, so the harvest's lower().compile() of the
+            # same program deserializes instead of recompiling, and it
+            # overlaps the segment's async execution. Measured times
+            # are observed by the callers that BLOCK on the results
+            # (dispatch here is async — timing it would be a lie).
+            harvest = self._prepare_harvest("train_segment", jit_train,
+                                            args)
+            out = jit_train(*args)
+            if harvest is not None:
+                harvest()
+            if track_norms:
+                params_list, opt_states, losses, metrics, norms = out
+                self.last_grad_norms = norms
+                return params_list, opt_states, losses, metrics
+            return out
 
         self._train_segment = _train_segment_call
 
@@ -321,9 +392,40 @@ class FusedTrainer(Logger):
         jit_eval = self._compile_eval(eval_segment_pure)
 
         def _eval_segment_call(params_list, idx_matrix):
-            return jit_eval(self._data_args, params_list, idx_matrix)
+            args = (self._data_args, params_list, idx_matrix)
+            harvest = self._prepare_harvest("eval_segment", jit_eval,
+                                            args)
+            out = jit_eval(*args)
+            if harvest is not None:
+                harvest()
+            return out
 
         self._eval_segment = _eval_segment_call
+
+    def _prepare_harvest(self, op, jit_fn, args):
+        """One-time cost-analysis harvest of a compiled segment
+        (veles_op_flops/veles_op_bytes + the ``compile`` startup
+        phase). Returns a thunk to invoke AFTER the real call (or None
+        when nothing to do): the abstract shapes captured here never
+        touch the donated buffers, and deferring the lower+compile
+        until the jit call has populated the persistent XLA cache
+        turns it into a cache deserialize. Never fatal — attribution
+        is advisory."""
+        book = profiler.get_cost_book()
+        if not book.needs_harvest(op):
+            return None
+        try:
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                               jnp.result_type(x)),
+                args)
+        except Exception:
+            return None
+
+        def harvest():
+            with profiler.phase("compile"):
+                book.harvest(op, jit_fn, abstract)
+        return harvest
 
     @staticmethod
     def _batch_confusion(out, truth, valid):

@@ -27,6 +27,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
+from veles_tpu.telemetry import profiler
 from veles_tpu.telemetry.registry import get_registry
 
 GARBAGE_TIMEOUT = 60
@@ -45,10 +46,13 @@ th { background: #eee; }
 <a href="/slaves.html">slave stats</a> ·
 <a href="/logs.html">logs</a> ·
 <a href="/frontend.html">command composer</a> ·
-<a href="/metrics">metrics</a></p>
+<a href="/metrics">metrics</a> ·
+<a href="/profile.json">profile</a></p>
+<div id="perf" style="margin-bottom:1em"></div>
 <table id="wf"><thead><tr>
 <th>id</th><th>name</th><th>mode</th><th>master</th><th>uptime</th>
-<th>slaves</th><th>units</th><th>serving</th><th>stopped</th>
+<th>slaves</th><th>units</th><th>serving</th><th>perf</th>
+<th>stopped</th>
 </tr></thead><tbody></tbody></table>
 <script>
 function servingCell(s) {
@@ -59,12 +63,66 @@ function servingCell(s) {
     " · p95 " + (s.p95_ms || 0) + "ms" +
     (s.rejected_total ? " · " + s.rejected_total + " shed" : "");
 }
+function perfCell(p) {
+  if (!p) return "";
+  let parts = [];
+  if (p.mfu) parts.push("MFU " + (p.mfu * 100).toFixed(1) + "%");
+  if (p.flight_record) parts.push("flight: " + p.flight_record);
+  return parts.join(" · ");
+}
+function fmtGB(b) { return (b / 1073741824).toFixed(2) + " GB"; }
+function renderPerf(p) {
+  const div = document.getElementById("perf");
+  let html = "";
+  if (p.step_mfu)
+    html += "<b>step MFU " + (p.step_mfu * 100).toFixed(1) + "%</b>";
+  const mem = p.memory || {};
+  const devs = Object.entries(mem.devices || {});
+  if (devs.length) {
+    html += "<table><thead><tr><th>device</th><th>HBM live</th>" +
+      "<th>HBM peak</th><th>limit</th></tr></thead><tbody>";
+    for (const [d, m] of devs)
+      html += "<tr><td>" + d + "</td><td>" + fmtGB(m.live_bytes || 0) +
+        "</td><td>" + fmtGB(m.peak_bytes || 0) + "</td><td>" +
+        fmtGB(m.limit_bytes || 0) + "</td></tr>";
+    html += "</tbody></table>";
+  }
+  const phases = Object.entries(p.phases_ms || {});
+  if (phases.length) {
+    // startup-phase bar: one stacked strip, widths proportional
+    const total = phases.reduce((a, kv) => a + kv[1], 0);
+    const hues = [210, 30, 120, 275, 0, 55];
+    html += "<div style='margin-top:0.5em'>startup phases (" +
+      (total / 1000).toFixed(1) + "s): </div>" +
+      "<div style='display:flex;width:40em;height:1.4em;" +
+      "border:1px solid #ccc'>";
+    phases.forEach(([name, ms], i) => {
+      const w = Math.max(100.0 * ms / Math.max(total, 1e-9), 0.5);
+      html += "<div title='" + name + ": " + ms.toFixed(0) +
+        "ms' style='width:" + w + "%;background:hsl(" +
+        hues[i % hues.length] + ",55%,70%)'></div>";
+    });
+    html += "</div><div style='font-size:0.85em;color:#555'>" +
+      phases.map(([n, ms]) => n + " " + ms.toFixed(0) + "ms")
+        .join(" · ") + "</div>";
+  }
+  if (p.flight_record)
+    html += "<div style='margin-top:0.5em'>last flight record: " +
+      "<code>" + p.flight_record + "</code></div>";
+  div.innerHTML = html;
+}
+async function refreshPerf() {
+  try {
+    const resp = await fetch("/profile.json");
+    renderPerf(await resp.json());
+  } catch (e) {}
+}
 async function refresh() {
   const resp = await fetch("/service", {method: "POST",
     headers: {"Content-Type": "application/json"},
     body: JSON.stringify({request: "workflows",
       args: ["name", "mode", "master", "time", "slaves", "units",
-             "serving", "stopped"]})});
+             "serving", "perf", "stopped"]})});
   const data = await resp.json();
   const tbody = document.querySelector("#wf tbody");
   tbody.innerHTML = "";
@@ -73,7 +131,8 @@ async function refresh() {
     const slaves = wf.slaves ? Object.keys(wf.slaves).length : 0;
     for (const v of [mid.slice(0, 8), wf.name, wf.mode, wf.master,
                      Math.round(wf.time) + "s", slaves, wf.units,
-                     servingCell(wf.serving), wf.stopped]) {
+                     servingCell(wf.serving), perfCell(wf.perf),
+                     wf.stopped]) {
       const td = document.createElement("td");
       td.textContent = v === undefined ? "" : String(v);
       tr.appendChild(td);
@@ -82,6 +141,7 @@ async function refresh() {
   }
 }
 refresh(); setInterval(refresh, 2000);
+refreshPerf(); setInterval(refreshPerf, 5000);
 </script></body></html>"""
 
 _SLAVES_PAGE = """<!DOCTYPE html>
@@ -511,6 +571,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.server.owner.count_request(self.path)
         if self.path in ("", "/", "/status.html"):
             self._reply(_STATUS_PAGE, ctype="text/html; charset=utf-8")
+        elif self.path.startswith("/profile.json"):
+            self._reply(profiler.profile_report())
         elif self.path.startswith("/metrics.json"):
             self._reply(get_registry().snapshot())
         elif self.path.startswith("/metrics"):
@@ -601,8 +663,8 @@ class WebStatusServer(Logger):
     KNOWN_PATHS = frozenset([
         "/", "/status.html", "/logs.html", "/slaves.html",
         "/frontend.html", "/workflow.html", "/timeline.html", "/catalog",
-        "/metrics", "/metrics.json", "/update", "/service", "/logs",
-        "/events"])
+        "/metrics", "/metrics.json", "/profile.json", "/update",
+        "/service", "/logs", "/events"])
 
     def count_request(self, path):
         path = path.split("?")[0] or "/"
