@@ -83,6 +83,62 @@ def _probe_workflow():
     return wf, wall
 
 
+def _input_pipeline_probe():
+    """ISSUE 8 overlap guard: a tiny streamed (out-of-core) run with a
+    throttled host ETL, synchronous vs prefetched. The waits are
+    sleep-dominated so the ratio is structural, not machine-speed:
+    if the pipeline silently degrades to the synchronous path the
+    ratio collapses to ~1 and the hard gate fails."""
+    import numpy
+
+    from veles_tpu import prng
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.loader import prefetch
+    from veles_tpu.models.mnist import MnistWorkflow
+    from veles_tpu.telemetry.registry import get_registry
+    from veles_tpu.train import FusedTrainer
+
+    saved = {k: os.environ.get(k) for k in
+             ("VELES_ETL_THROTTLE_MS", "VELES_SHARD_MB")}
+    os.environ["VELES_ETL_THROTTLE_MS"] = "40"
+    os.environ["VELES_SHARD_MB"] = "0.004"  # 1 minibatch per shard
+
+    rng = numpy.random.RandomState(SEED)
+    x = rng.rand(200, 6, 6).astype(numpy.float32)
+    y = (x.reshape(200, -1).sum(1) > 18).astype(numpy.int32)
+
+    def run(depth, workers):
+        hist = get_registry().get("veles_step_input_wait_ms")
+        if hist is not None:
+            hist.reset()
+        prng.get().seed(SEED)
+        prng.get("loader").seed(SEED + 1)
+        wf = MnistWorkflow(
+            DummyLauncher(),
+            provider=lambda: (x[:160], y[:160], x[160:], y[160:]),
+            layers=(16,), minibatch_size=20, max_epochs=1)
+        wf.initialize(device=Device(backend=None))
+        trainer = FusedTrainer(wf, stream=True, prefetch_depth=depth,
+                               prefetch_workers=workers)
+        trainer.train()
+        child = get_registry().get("veles_step_input_wait_ms").labels()
+        return child.sum
+
+    try:
+        sync_ms = run(0, 1)
+        deep_ms = run(4, 4)
+    finally:
+        prefetch.shutdown_all()
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+    return {"step_input_wait_ms": deep_ms,
+            "input_wait_overlap_ratio": sync_ms / max(deep_ms, 1e-9)}
+
+
 def capture():
     """Run the probe and return the snapshot dict."""
     from veles_tpu.telemetry import profiler
@@ -113,6 +169,7 @@ def capture():
     rss = profiler.host_rss_bytes()
     if rss:
         metrics["host_rss_gb"] = rss / 2.0 ** 30
+    metrics.update(_input_pipeline_probe())
     return {"schema": "veles-perf-snapshot/1",
             "probe": {"samples": SAMPLES, "batch": BATCH,
                       "epochs": EPOCHS, "seed": SEED},

@@ -89,6 +89,11 @@ def _test_watchdog():
 @pytest.fixture(autouse=True, scope="session")
 def _stop_telemetry_threads():
     yield
+    # prefetch pipelines first: their workers hold jax arrays, and a
+    # worker mid-device_put through interpreter teardown is the same
+    # "terminate called without an active exception" window
+    from veles_tpu.loader import prefetch
+    prefetch.shutdown_all()
     from veles_tpu.telemetry import flight, profiler
     flight.reset_recorder()
     profiler.stop_memory_sampler()

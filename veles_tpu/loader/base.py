@@ -129,6 +129,36 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
     def on_before_fill(self):
         pass
 
+    # -- prefetchable fill (the async input pipeline's ETL hook) -----------
+
+    def fill_indices(self, indices, kind="labels"):
+        """Host ETL for an arbitrary index vector, WITHOUT touching the
+        unit's minibatch state: returns ``(data_rows, truth_rows)``
+        host ndarrays where index −1 yields a zero data row and truth
+        is taken at ``max(idx, 0)`` (masked later by the loss math —
+        the on-device gather's exact padding contract).
+
+        Must be thread-safe over read-only backing state: the prefetch
+        pipeline (:mod:`veles_tpu.loader.prefetch`) calls it from
+        worker threads while the step thread computes."""
+        raise NotImplementedError(
+            "%s does not support prefetchable fills" % self.name)
+
+    def iter_shards(self, klass, shard_samples):
+        """Yield the class's shuffled sample indices in fixed-size
+        shards of ``shard_samples`` (last one short) — the shard
+        iteration helper for NON-fused out-of-core consumers (e.g.
+        serving warm-up feeding ``fill_indices``). The fused streamed
+        path shards its compiled index matrix directly
+        (``FusedTrainer._shard_bounds``), not through this."""
+        ends = self.class_end_offsets
+        start = ends[klass] - self.class_lengths[klass]
+        seg = numpy.asarray(
+            self.shuffled_indices.map_read()[start:ends[klass]],
+            numpy.int32)
+        for offset in range(0, len(seg), shard_samples):
+            yield seg[offset:offset + shard_samples]
+
     # -- lifecycle ---------------------------------------------------------
 
     def initialize(self, **kwargs):

@@ -121,6 +121,25 @@ class FullBatchLoader(Loader):
         if labels is not None:
             self.minibatch_labels.assign_devmem(labels)
 
+    # -- prefetchable fill (host backing for the async input pipeline) -----
+
+    def host_backing(self, kind="labels"):
+        """``(data, truth)`` host ndarray views of the full-batch
+        backing store — what streamed (out-of-core) consumers gather
+        shards from instead of forcing the dataset device-resident.
+        ``kind`` selects ``labels`` or ``targets`` as truth."""
+        truth = (self.original_labels if kind == "labels"
+                 else getattr(self, "original_targets", None))
+        if truth is None or truth.mem is None:
+            raise ValueError("%s has no host-resident %s"
+                             % (self.name, kind))
+        return self.original_data.map_read(), truth.map_read()
+
+    def fill_indices(self, indices, kind="labels"):
+        from veles_tpu.loader.prefetch import gather_rows
+        data, truth = self.host_backing(kind)
+        return gather_rows(data, truth, indices)
+
 
 class ProviderLoader(FullBatchLoader):
     """Full batch over a provider callable returning

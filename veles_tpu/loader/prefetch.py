@@ -1,0 +1,437 @@
+"""Async double-buffered input pipeline (ISSUE 8, ROADMAP item 4).
+
+The fused step compiler eliminated per-step host traffic for datasets
+that fit in device memory — but only for those. This module supplies
+the other half of ROADMAP item 4:
+
+* :class:`PrefetchPipeline` — a bounded-depth background pipeline.
+  Worker threads run host ETL (``fill_minibatch``-style row gathers)
+  and issue the host→device transfer for shard N+k while the step
+  thread computes shard N, so the step thread's input wait collapses
+  to the pipeline's warm fill plus whatever ETL cannot be hidden
+  behind compute (the libhclooc out-of-core overlap pattern,
+  PAPERS.md). Depth is ``VELES_PREFETCH`` (default 2 =
+  double-buffered; 0 reproduces the synchronous path exactly).
+
+* :class:`StagingRing` — a small ring of device staging slots the
+  transfers land in. Residency is bounded to ``depth + 2`` shards
+  (the one in compute, the queued ones, one being placed); a slot's
+  previous occupant is deleted deterministically when the slot is
+  reused, so out-of-core streaming has a flat HBM footprint however
+  long the epoch.
+
+* residency planning — :func:`plan_residency` decides
+  "device-resident when it fits, streamed when it doesn't" against
+  the device budget (``VELES_DEVICE_BUDGET_MB`` override — the
+  artificial cap the out-of-core tests and benches use — else a
+  fraction of the device's reported ``bytes_limit``), and
+  :func:`shard_batches` sizes the fixed shards (``VELES_SHARD_MB``).
+
+Telemetry (docs/OBSERVABILITY.md): every consumer-side wait lands in
+the ``veles_step_input_wait_ms`` histogram; per-segment starvation
+fraction (wait / wall) is published as the
+``veles_input_starvation_fraction`` gauge by the streamed drivers in
+:mod:`veles_tpu.train.step`; ETL / transfer times ride
+``veles_prefetch_etl_ms`` / ``veles_prefetch_h2d_ms`` and
+``prefetch:*`` trace spans; the time to the first ready item is the
+``pipeline_fill`` startup phase.
+
+``VELES_ETL_THROTTLE_MS`` injects a per-shard host-ETL sleep — the
+deliberately slow loader that ``scripts/input_bench.py`` and the perf
+gate's overlap probe use to measure (not assert) the overlap win.
+"""
+
+import os
+import threading
+import time
+import weakref
+
+import numpy
+
+from veles_tpu.telemetry import tracing
+
+#: live pipelines (weak): the conftest session teardown closes any a
+#: crashed test left running before the interpreter starts dying
+_live_lock = threading.Lock()
+_live = weakref.WeakSet()
+
+
+def default_depth():
+    """``VELES_PREFETCH`` (default 2; 0 = synchronous)."""
+    try:
+        return max(0, int(os.environ.get("VELES_PREFETCH", "2")))
+    except ValueError:
+        return 2
+
+
+def default_workers():
+    """``VELES_PREFETCH_WORKERS`` ETL threads (default 1)."""
+    try:
+        return max(1, int(os.environ.get("VELES_PREFETCH_WORKERS", "1")))
+    except ValueError:
+        return 1
+
+
+def etl_throttle_s():
+    """Injected per-shard ETL sleep (``VELES_ETL_THROTTLE_MS``) — the
+    slow-loader simulation knob for benches/tests; 0 in production."""
+    try:
+        return max(0.0, float(
+            os.environ.get("VELES_ETL_THROTTLE_MS", "0"))) / 1e3
+    except ValueError:
+        return 0.0
+
+
+def _registry():
+    from veles_tpu.telemetry.registry import get_registry
+    return get_registry()
+
+
+def input_wait_histogram():
+    return _registry().histogram(
+        "veles_step_input_wait_ms",
+        "Step-thread wait for the next prefetched input shard")
+
+
+def starvation_gauge():
+    return _registry().gauge(
+        "veles_input_starvation_fraction",
+        "Input wait / wall fraction of the last streamed segment",
+        labels=("phase",))
+
+
+# -- the pipeline ------------------------------------------------------------
+
+
+class PrefetchPipeline(object):
+    """Ordered bounded-depth producer pipeline over ``n_items`` items.
+
+    ``produce(i)`` runs on worker threads (host ETL + async H2D
+    dispatch); the consumer calls :meth:`get` and receives items
+    strictly in index order. At most ``depth`` produced-but-unconsumed
+    items exist at any time, so device staging memory is bounded.
+
+    A worker exception is delivered to the consumer: the :meth:`get`
+    that reaches the failed index re-raises it (after closing the
+    pipeline), so a broken loader fails the step loop loudly instead
+    of hanging it. ``depth=0`` runs ``produce`` inline on the consumer
+    thread — bit-identical to the pre-pipeline synchronous path, with
+    the same telemetry (the wait IS the ETL+transfer time).
+    """
+
+    def __init__(self, produce, n_items, depth=None, workers=None,
+                 name="input"):
+        self.produce = produce
+        self.n_items = int(n_items)
+        self.depth = default_depth() if depth is None else max(0, depth)
+        self.workers = default_workers() if workers is None \
+            else max(1, workers)
+        self.name = name
+        self.wait_s = 0.0          #: cumulative consumer wait
+        self.first_wait_s = None   #: warm fill (wait for item 0)
+        self._cond = threading.Condition()
+        self._results = {}         # index -> ("ok", item) | ("error", e)
+        self._next_claim = 0
+        self._next_get = 0
+        self._stop = False
+        self._threads = []
+        self._wait_hist = input_wait_histogram()
+
+    # -- worker side --------------------------------------------------------
+
+    def start(self):
+        if self.depth == 0 or self.n_items == 0:
+            return self  # synchronous mode: no threads at all
+        for k in range(min(self.workers, self.n_items)):
+            t = threading.Thread(
+                target=self._work, daemon=True,
+                name="veles-prefetch-%s-%d" % (self.name, k))
+            t.start()
+            self._threads.append(t)
+        with _live_lock:
+            _live.add(self)
+        return self
+
+    def _work(self):
+        while True:
+            with self._cond:
+                while (not self._stop and
+                       self._next_claim < self.n_items and
+                       self._next_claim - self._next_get >= self.depth):
+                    self._cond.wait(0.1)
+                if self._stop or self._next_claim >= self.n_items:
+                    return
+                i = self._next_claim
+                self._next_claim += 1
+            try:
+                with tracing.span("prefetch:produce", index=i,
+                                  pipeline=self.name):
+                    out = ("ok", self.produce(i))
+            except BaseException as e:  # delivered to the consumer
+                out = ("error", e)
+            with self._cond:
+                self._results[i] = out
+                self._cond.notify_all()
+                if out[0] == "error":
+                    # stop claiming new work; indices already claimed
+                    # by other workers still complete, so the consumer
+                    # reaches this error without gaps
+                    self._next_claim = self.n_items
+
+    # -- consumer side ------------------------------------------------------
+
+    def get(self):
+        """Next item in order. Returns ``(item, wait_s)``; re-raises a
+        worker exception at its index."""
+        i = self._next_get
+        if i >= self.n_items:
+            raise IndexError("pipeline of %d items exhausted"
+                             % self.n_items)
+        start = time.perf_counter()
+        if self.depth == 0:
+            try:
+                payload = self.produce(i)
+            finally:
+                self._next_get = i + 1
+            kind = "ok"
+        else:
+            with self._cond:
+                while i not in self._results and not self._stop:
+                    self._cond.wait(0.1)
+                if i not in self._results:
+                    raise RuntimeError(
+                        "prefetch pipeline %r closed while the step "
+                        "thread waited for item %d" % (self.name, i))
+                kind, payload = self._results.pop(i)
+                self._next_get = i + 1
+                self._cond.notify_all()
+        wait = time.perf_counter() - start
+        self.wait_s += wait
+        self._wait_hist.observe(wait * 1e3)
+        tracing.add_complete("prefetch:wait", start, wait, index=i,
+                             pipeline=self.name)
+        if self.first_wait_s is None:
+            self.first_wait_s = wait
+            from veles_tpu.telemetry import profiler
+            profiler.record_phase("pipeline_fill", wait)
+        if kind == "error":
+            self.close()
+            raise payload
+        return payload, wait
+
+    def __iter__(self):
+        while self._next_get < self.n_items:
+            yield self.get()[0]
+
+    def close(self, timeout=10.0):
+        """Stop the workers and join every pipeline thread."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = [t for t in self._threads if t.is_alive()]
+        with _live_lock:
+            # a worker stuck past the join timeout keeps the pipeline
+            # registered so shutdown_all() can retry before teardown
+            if not self._threads:
+                _live.discard(self)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def shutdown_all(timeout=10.0):
+    """Close every live pipeline (conftest session teardown: worker
+    threads must not outlive pytest into interpreter shutdown)."""
+    with _live_lock:
+        pipes = list(_live)
+    for p in pipes:
+        p.close(timeout)
+
+
+# -- device staging ----------------------------------------------------------
+
+
+class StagingRing(object):
+    """Fixed ring of device staging slots for streamed shards.
+
+    ``place()`` transfers a tuple of host arrays through the next slot
+    and deletes the slot's previous occupant first, so at most
+    ``slots`` shards are ever device-resident — the flat-HBM guarantee
+    out-of-core streaming depends on. ``placer`` maps one host array
+    to its device form (plain ``device_put``, or a ``NamedSharding``
+    placement for data-parallel meshes).
+    """
+
+    def __init__(self, slots, placer):
+        self._lock = threading.Lock()
+        self._slots = [None] * max(1, int(slots))
+        self._pos = 0
+        self._placer = placer
+        self._closed = False
+
+    @staticmethod
+    def _delete(arrays):
+        for arr in arrays:
+            try:
+                # PJRT defers the actual free until in-flight executions
+                # using the buffer complete, so deleting here (while the
+                # previous shard may still be computing) is safe — the
+                # residency BOUND is what this ring guarantees
+                arr.delete()
+            except Exception:
+                pass  # already consumed/deleted: bound still holds
+
+    def place(self, host_arrays):
+        with self._lock:
+            idx = self._pos % len(self._slots)
+            self._pos += 1
+            old = self._slots[idx]
+            self._slots[idx] = None
+        if old is not None:
+            self._delete(old)
+        placed = tuple(self._placer(a) for a in host_arrays)
+        with self._lock:
+            if self._closed:
+                # clear() raced an in-flight place (a worker past its
+                # join timeout): don't re-insert into the emptied ring
+                # — drop our own shard so shutdown's residency promise
+                # holds; the (dead) consumer never uses it
+                drop, placed_slot = placed, None
+            else:
+                drop, placed_slot = None, placed
+                self._slots[idx] = placed_slot
+        if drop is not None:
+            self._delete(drop)
+        return placed
+
+    def reopen(self):
+        """Accept placements again after a :meth:`clear` (a trainer
+        reused across runs reopens its ring per segment)."""
+        with self._lock:
+            self._closed = False
+
+    def clear(self):
+        with self._lock:
+            self._closed = True
+            slots, self._slots = self._slots, [None] * len(self._slots)
+        for old in slots:
+            if old is not None:
+                self._delete(old)
+
+
+def default_placer(device=None):
+    """Host ndarray -> committed ``jax.Array`` (async on TPU)."""
+    import jax
+    if device is not None and getattr(device, "is_jax", False):
+        return device.put
+    return jax.device_put
+
+
+# -- residency planning ------------------------------------------------------
+
+
+def device_budget_bytes(device=None):
+    """Bytes of device memory the DATASET may occupy resident.
+
+    ``VELES_DEVICE_BUDGET_MB`` wins (the artificial cap out-of-core
+    tests/benches set; ``0``/empty = unknown); else 60% of the
+    device's reported ``bytes_limit`` (params, activations and XLA
+    scratch need the rest); else None (unknown — stay resident, the
+    pre-pipeline behavior)."""
+    env = os.environ.get("VELES_DEVICE_BUDGET_MB")
+    if env:
+        try:
+            mb = float(env)
+            return mb * 1e6 if mb > 0 else None
+        except ValueError:
+            pass
+    stats = {}
+    try:
+        if device is not None and getattr(device, "is_jax", False):
+            stats = device.memory_stats or {}
+        else:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    limit = stats.get("bytes_limit")
+    return 0.6 * limit if limit else None
+
+
+def plan_residency(dataset_bytes, device=None, force=None):
+    """``"resident"`` or ``"streamed"`` for a dataset of
+    ``dataset_bytes``.
+
+    ``force`` (or ``VELES_STREAM``: ``1``/``force``/``on`` stream
+    always, ``0``/``off``/``no`` never; anything else is ignored and
+    the budget decides) overrides the budget comparison."""
+    if force is None:
+        env = os.environ.get("VELES_STREAM")
+        if env in ("1", "force", "on", "yes", "true"):
+            force = True
+        elif env in ("0", "off", "no", "false"):
+            force = False
+    if force is not None:
+        return "streamed" if force else "resident"
+    budget = device_budget_bytes(device)
+    if budget is not None and dataset_bytes > budget:
+        return "streamed"
+    return "resident"
+
+
+def shard_batches(batch_bytes, depth=None, budget_bytes=None):
+    """Minibatches per fixed-size streamed shard.
+
+    Targets ``VELES_SHARD_MB`` (default 256) per shard, shrunk so the
+    ring's ``depth + 2`` resident shards still fit the device budget
+    when one is known."""
+    try:
+        target = float(os.environ.get("VELES_SHARD_MB", "256")) * 1e6
+    except ValueError:
+        target = 256e6
+    depth = default_depth() if depth is None else depth
+    if budget_bytes:
+        target = min(target, budget_bytes / (depth + 2))
+    return max(1, int(target // max(1, batch_bytes)))
+
+
+# -- host ETL ----------------------------------------------------------------
+
+
+def gather_rows(data, truth, indices):
+    """``fill_minibatch``-style host ETL for one shard: gather rows of
+    ``data``/``truth`` by global sample index.
+
+    Matches the on-device gather's padding contract exactly
+    (:meth:`FusedTrainer._gather`): index −1 produces a ZERO data row;
+    truth is taken at ``max(idx, 0)`` and masked later by the loss
+    math. Pure function over host arrays — safe from worker threads.
+    """
+    throttle = etl_throttle_s()
+    if throttle:
+        time.sleep(throttle)
+    indices = numpy.asarray(indices).reshape(-1)
+    safe = numpy.maximum(indices, 0)
+    rows = data[safe]  # fancy index: always a fresh writable copy
+    invalid = indices < 0
+    if invalid.any():
+        rows[invalid] = 0
+    return rows, truth[safe]
+
+
+def local_indices(global_idx):
+    """Shard-local index matrix for a shard built by
+    :func:`gather_rows`: row i of the shard replaces global sample
+    ``global_idx.flat[i]``, pads stay −1 so the in-scan valid mask
+    (and therefore the loss math) is unchanged."""
+    global_idx = numpy.asarray(global_idx)
+    flat = global_idx.reshape(-1)
+    local = numpy.where(flat < 0, -1,
+                        numpy.arange(flat.size)).astype(numpy.int32)
+    return local.reshape(global_idx.shape)

@@ -38,6 +38,11 @@ class DataParallelTrainer(FusedTrainer):
         # whose in_shardings read this spec
         self._data_spec = named_sharding(self.mesh, axis)
         super(DataParallelTrainer, self).__init__(workflow, **kwargs)
+        if self.streaming:
+            # out-of-core: shards flow through the prefetch staging
+            # ring, placed per-device by _shard_placer — there is no
+            # resident dataset to row-shard
+            return
         # the loader uploaded the dataset committed to ONE device
         # (memory.py device_put). SHARD it over the data axis — a
         # replicated dataset multiplies HBM by mesh size and cannot fit
@@ -47,21 +52,15 @@ class DataParallelTrainer(FusedTrainer):
         # ICI; serving order (and therefore the math) is identical to a
         # single device. The sample dim is padded to divide the axis —
         # indices never reach the pad rows.
-        n_shards = self.mesh.shape[axis]
-
-        def shard_rows(a):
-            # stage through HOST memory: padding on-device would hold a
-            # second full-size copy on the loader's device — exactly
-            # the 2x HBM peak this sharding exists to avoid
-            import numpy
-            a = numpy.asarray(a)
-            pad = -a.shape[0] % n_shards
-            if pad:
-                a = numpy.concatenate(
-                    [a, numpy.zeros((pad,) + a.shape[1:], a.dtype)])
-            return put_global(a, self._data_spec)
-
-        self._data_args = tuple(shard_rows(a) for a in self._data_args)
+        import numpy
+        # stage through HOST memory: padding on-device would hold a
+        # second full-size copy on the loader's device — exactly the
+        # 2x HBM peak this sharding exists to avoid. _shard_placer is
+        # the ONE pad-and-place implementation (streamed shards use it
+        # per shard; here it places the whole dataset once).
+        place = self._shard_placer()
+        self._data_args = tuple(place(numpy.asarray(a))
+                                for a in self._data_args)
         # the loader's Arrays still hold the FULL dataset committed to
         # one device (FusedTrainer.__init__ forced .devmem to build
         # _data_args) — release those buffers so that device holds only
@@ -71,6 +70,35 @@ class DataParallelTrainer(FusedTrainer):
                     if self.loss_kind == "softmax"
                     else self.loader.original_targets):
             arr.release_devmem()
+
+    def _dataset_device_bytes(self, total_bytes):
+        # row-sharded residency: each device holds 1/N of the dataset,
+        # so the stream-vs-resident decision compares the SHARD size
+        # against one device's budget
+        return total_bytes / self.mesh.shape[self.axis]
+
+    def _shard_placer(self):
+        """Streamed shards land directly as addressable per-device
+        shards of the ``data``-axis ``NamedSharding`` — each device
+        receives its row slice of the host shard straight from host
+        memory (``put_global``: plain sharded ``device_put``
+        single-process, ``make_array_from_callback`` multi-controller).
+        No device ever sees the full shard, and there is no
+        gather-then-scatter hop."""
+        n_shards = self.mesh.shape[self.axis]
+        spec = self._data_spec
+
+        def place(host_array):
+            import numpy
+            pad = -host_array.shape[0] % n_shards
+            if pad:
+                # local shard indices never reach the pad rows
+                host_array = numpy.concatenate([
+                    host_array,
+                    numpy.zeros((pad,) + host_array.shape[1:],
+                                host_array.dtype)])
+            return put_global(host_array, spec)
+        return place
 
     def _params_spec(self):
         if self._param_shardings is not None:

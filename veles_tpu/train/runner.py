@@ -135,6 +135,11 @@ class FusedRunner(Logger):
         self._book = profiler.get_cost_book()
         self._epoch_index = 0
         self._first_step_done = False
+        # streamed (out-of-core) input pipeline: per-epoch starvation
+        # fraction = step-thread input wait / epoch wall (the overlap
+        # win of ISSUE 8, measured not asserted)
+        from veles_tpu.loader import prefetch
+        self._starvation = prefetch.starvation_gauge()
 
     def _timed_step(self, phase, fn, *args, **kwargs):
         """Run one sweep under a span + the step histogram, with the
@@ -410,6 +415,7 @@ class FusedRunner(Logger):
                     loader.epoch_ended <<= False
                     loader.last_minibatch <<= False
                 epoch_start = time.perf_counter()
+                epoch_wait0 = trainer.input_wait_s
                 testing = bool(decision.testing)
                 stats = self._timed_step("eval", self._eval_classes,
                                          params, testing)
@@ -430,6 +436,14 @@ class FusedRunner(Logger):
                 self._epoch_ms.observe(epoch_elapsed * 1e3)
                 tracing.add_complete("epoch", epoch_start, epoch_elapsed,
                                      index=epochs_done)
+                if getattr(trainer, "streaming", False) and \
+                        epoch_elapsed > 0:
+                    epoch_wait = trainer.input_wait_s - epoch_wait0
+                    fraction = min(1.0, epoch_wait / epoch_elapsed)
+                    self._starvation.labels(phase="epoch").set(fraction)
+                    self.debug("epoch %d input wait %.0f ms "
+                               "(%.1f%% starved)", epochs_done,
+                               epoch_wait * 1e3, fraction * 100.0)
                 epochs_done += 1
                 self._epoch_index = epochs_done
                 samples_done += sum(s["samples"] for s in stats.values())
@@ -446,6 +460,9 @@ class FusedRunner(Logger):
             # snapshot (eager keeps unit arrays current every minibatch)
             if params is not None:
                 trainer.push_params(params, states)
+            # join any prefetch workers / drop staged shards: pipeline
+            # threads must never outlive the run (crash/Ctrl-C included)
+            trainer.shutdown()
             workflow.is_running = False
             elapsed = time.perf_counter() - start
             workflow._run_time += elapsed

@@ -38,19 +38,32 @@ import jax.numpy as jnp
 import numpy
 
 from veles_tpu import prng
+from veles_tpu.loader import prefetch
 from veles_tpu.loader.base import TEST, TRAIN, VALIDATION, CLASS_NAMES
 from veles_tpu.logger import Logger
 from veles_tpu.nn.dropout import DropoutForward
 from veles_tpu.nn.evaluator import EvaluatorMSE, EvaluatorSoftmax
 from veles_tpu.nn.optim import get_solver
-from veles_tpu.telemetry import profiler
+from veles_tpu.telemetry import profiler, tracing
 
 
 class FusedTrainer(Logger):
-    """Compiles and drives the fused train/eval loop of a workflow."""
+    """Compiles and drives the fused train/eval loop of a workflow.
+
+    Dataset residency generalizes the old all-or-nothing staging:
+    *staged-resident* when the dataset fits the device budget (the
+    pre-existing path, including the space-to-depth staging pack),
+    *streamed* when it doesn't — fixed-size shards are host-gathered
+    and transferred through :mod:`veles_tpu.loader.prefetch`'s
+    double-buffered staging ring while the previous shard computes,
+    so datasets larger than HBM train out-of-core instead of OOMing.
+    ``stream=None`` auto-decides (``VELES_STREAM`` /
+    ``VELES_DEVICE_BUDGET_MB`` override); True/False force.
+    """
 
     def __init__(self, workflow, donate=None, stage_s2d=True,
-                 grad_norms=None):
+                 grad_norms=None, stream=None, prefetch_depth=None,
+                 prefetch_workers=None):
         super(FusedTrainer, self).__init__()
         self.workflow = workflow
         self.loader = workflow.loader
@@ -59,6 +72,13 @@ class FusedTrainer(Logger):
         self.decision = workflow.decision
         self.donate = self._resolve_donate(donate)
         self.stage_s2d = stage_s2d
+        self.stream = stream
+        self.prefetch_depth = prefetch_depth
+        self.prefetch_workers = prefetch_workers
+        #: cumulative step-thread input wait (streamed mode); the
+        #: runner reads deltas of this per epoch
+        self.input_wait_s = 0.0
+        self._active_pipeline = None
         # per-batch global gradient norms ride the train scan (the
         # flight recorder's divergence detector input); the norm is a
         # pure observation over grads the solver reads anyway, so the
@@ -240,6 +260,203 @@ class FusedTrainer(Logger):
                    raw.shape, packed.shape)
         return packed
 
+    # -- dataset residency: staged-resident OR streamed --------------------
+
+    def _dataset_device_bytes(self, total_bytes):
+        """Bytes of the dataset ONE device would hold resident (the
+        data-parallel trainer divides by its shard count)."""
+        return total_bytes
+
+    def _shard_placer(self):
+        """host ndarray -> device shard array; the data-parallel
+        trainer overrides this with a mesh-sharded placement."""
+        return prefetch.default_placer(
+            getattr(self.loader.original_data, "device", None))
+
+    def _setup_data_residency(self):
+        """The generalization of the old all-or-nothing staging:
+        *staged-resident* (s2d-packed where applicable) when the
+        dataset fits the device budget, *streamed* out-of-core through
+        the prefetch staging ring when it doesn't."""
+        loader = self.loader
+        truth_arr = (loader.original_labels
+                     if self.loss_kind == "softmax"
+                     else loader.original_targets)
+        total_bytes = loader.original_data.nbytes + truth_arr.nbytes
+        device = getattr(loader.original_data, "device", None)
+        self.streaming = prefetch.plan_residency(
+            self._dataset_device_bytes(total_bytes), device=device,
+            force=self.stream) == "streamed"
+        if self.streaming and not hasattr(loader, "host_backing"):
+            self.warning("loader %s has no host backing store — "
+                         "cannot stream; forcing the dataset resident",
+                         loader.name)
+            self.streaming = False
+        if not self.streaming:
+            staged = self._maybe_stage_s2d()
+            self._staged_s2d = staged is not None
+            self._data_args = (
+                staged if staged is not None
+                else loader.original_data.devmem,
+                truth_arr.devmem)
+            return
+        # streamed: the dataset NEVER becomes fully device-resident.
+        # Space-to-depth staging is skipped — apply() packs per step,
+        # trading ~1.5 ms/step (flagship) for fitting at all.
+        self._staged_s2d = False
+        self._data_args = None
+        self._truth_kind = ("labels" if self.loss_kind == "softmax"
+                            else "targets")
+        data, truth = loader.host_backing(self._truth_kind)
+        # an eager init may already have uploaded the full copy — a
+        # streamed run must not keep it resident alongside the ring
+        loader.original_data.release_devmem()
+        truth_arr.release_devmem()
+        mb = loader.max_minibatch_size
+        batch_bytes = mb * (
+            int(numpy.prod(data.shape[1:], dtype=numpy.int64)) *
+            data.dtype.itemsize +
+            int(numpy.prod(truth.shape[1:], dtype=numpy.int64)) *
+            truth.dtype.itemsize)
+        depth = (prefetch.default_depth() if self.prefetch_depth is None
+                 else self.prefetch_depth)
+        # shard sizing is per-DEVICE, like the budget: a data-parallel
+        # mesh holds 1/N of every shard per device, so its shards carry
+        # N times the minibatches for the same footprint
+        self._batches_per_shard = prefetch.shard_batches(
+            self._dataset_device_bytes(batch_bytes), depth=depth,
+            budget_bytes=prefetch.device_budget_bytes(device))
+        self._staging_ring = prefetch.StagingRing(
+            max(1, depth) + 2, self._shard_placer())
+        from veles_tpu.telemetry.registry import get_registry
+        registry = get_registry()
+        self._etl_ms = registry.histogram(
+            "veles_prefetch_etl_ms", "Host ETL time per streamed shard")
+        self._h2d_ms = registry.histogram(
+            "veles_prefetch_h2d_ms",
+            "Host->device transfer dispatch time per streamed shard")
+        self.info(
+            "dataset streams out-of-core: %.0f MB exceeds the device "
+            "budget; shards of %d minibatches (%.0f MB), prefetch "
+            "depth %d", total_bytes / 1e6, self._batches_per_shard,
+            self._batches_per_shard * batch_bytes / 1e6, depth)
+
+    def _shard_bounds(self, n_rows):
+        """[(row0, row1)] index-matrix row ranges, one per shard."""
+        rows = max(1, min(self._batches_per_shard, n_rows))
+        return [(r, min(r + rows, n_rows))
+                for r in range(0, n_rows, rows)]
+
+    def _stream_segment(self, kind, run_shard, idx_matrix):
+        """Drive one class sweep shard-by-shard through the prefetch
+        pipeline: worker threads fill+transfer shard N+k while
+        ``run_shard(data_args, local_idx, row0, row1)`` computes shard
+        N. Returns the list of per-shard outputs; publishes the step
+        thread's input-wait histogram + starvation gauge."""
+        idx_np = numpy.asarray(idx_matrix, numpy.int32)
+        bounds = self._shard_bounds(idx_np.shape[0])
+        ring = self._staging_ring
+        loader = self.loader
+        truth_kind = self._truth_kind
+
+        def produce(i):
+            row0, row1 = bounds[i]
+            rows_idx = idx_np[row0:row1]
+            t0 = time.perf_counter()
+            data_rows, truth_rows = loader.fill_indices(
+                rows_idx, kind=truth_kind)
+            etl = time.perf_counter() - t0
+            self._etl_ms.observe(etl * 1e3)
+            tracing.add_complete("prefetch:etl", t0, etl, shard=i)
+            t1 = time.perf_counter()
+            placed = ring.place((data_rows, truth_rows))
+            local = jnp.asarray(prefetch.local_indices(rows_idx))
+            h2d = time.perf_counter() - t1
+            self._h2d_ms.observe(h2d * 1e3)
+            tracing.add_complete("prefetch:h2d", t1, h2d, shard=i)
+            return placed, local, row0, row1
+
+        pipe = prefetch.PrefetchPipeline(
+            produce, len(bounds), depth=self.prefetch_depth,
+            workers=self.prefetch_workers, name=kind)
+        self._active_pipeline = pipe
+        outs = []
+        start = time.perf_counter()
+        try:
+            ring.reopen()  # a prior shutdown() may have closed it
+            pipe.start()
+            for _ in range(len(bounds)):
+                (placed, local, row0, row1), _ = pipe.get()
+                outs.append(run_shard(placed, local, row0, row1))
+        finally:
+            pipe.close()
+            self._active_pipeline = None
+            self.input_wait_s += pipe.wait_s
+            wall = time.perf_counter() - start
+            if wall > 0:
+                prefetch.starvation_gauge().labels(phase=kind).set(
+                    min(1.0, pipe.wait_s / wall))
+        return outs
+
+    def _train_segment_streamed(self, jit_train, params_list,
+                                opt_states, idx_matrix, keys):
+        state = [params_list, opt_states]
+
+        def run_shard(data_args, local_idx, row0, row1):
+            args = (data_args, state[0], state[1], local_idx,
+                    keys[row0:row1])
+            harvest = self._prepare_harvest("train_segment", jit_train,
+                                            args)
+            out = jit_train(*args)
+            if harvest is not None:
+                harvest()
+            state[0], state[1] = out[0], out[1]
+            return out[2:]
+
+        outs = self._stream_segment("train", run_shard, idx_matrix)
+        merged = tuple(jnp.concatenate(parts)
+                       for parts in zip(*outs))
+        if self.track_grad_norms:
+            losses, metrics, norms = merged
+            self.last_grad_norms = norms
+            return state[0], state[1], losses, metrics
+        return (state[0], state[1]) + merged
+
+    def _eval_segment_streamed(self, jit_eval, params_list, idx_matrix):
+        def run_shard(data_args, local_idx, row0, row1):
+            args = (data_args, params_list, local_idx)
+            harvest = self._prepare_harvest("eval_segment", jit_eval,
+                                            args)
+            out = jit_eval(*args)
+            if harvest is not None:
+                harvest()
+            return out
+
+        outs = self._stream_segment("eval", run_shard, idx_matrix)
+        losses = jnp.concatenate([o[0] for o in outs])
+        metrics = jnp.concatenate([o[1] for o in outs])
+        if len(outs[0]) == 3:
+            conf = outs[0][2]
+            for o in outs[1:]:
+                conf = conf + o[2]
+            return losses, metrics, conf
+        return losses, metrics
+
+    def shutdown(self):
+        """Join any live prefetch pipeline and drop staged shards.
+
+        Idempotent: the streamed drivers already close their pipeline
+        per segment — this is the crash/Ctrl-C backstop the runner
+        (and tests' session teardown) call so worker threads never
+        outlive the run."""
+        pipe = self._active_pipeline
+        if pipe is not None:
+            pipe.close()
+            self._active_pipeline = None
+        ring = getattr(self, "_staging_ring", None)
+        if ring is not None:
+            ring.clear()
+
     @staticmethod
     def _gather(data_args, idx):
         dataset, truth_src = data_args
@@ -265,22 +482,15 @@ class FusedTrainer(Logger):
         self.solvers = solvers
         self.hypers = hypers
 
-        # resolve the dataset's device arrays OUTSIDE any trace: calling
+        # resolve the dataset's residency OUTSIDE any trace: calling
         # .devmem under jit would cache a tracer inside the Array.
-        # CRITICAL: they are passed to the compiled functions as
-        # ARGUMENTS, never closed over — a closure-captured array is
+        # CRITICAL: device arrays are passed to the compiled functions
+        # as ARGUMENTS, never closed over — a closure-captured array is
         # baked into the HLO as a constant, which (a) bloats the
         # program by the whole dataset (hundreds of MB for ImageNet
         # shapes — enough to kill remote-compile services) and (b)
         # defeats donation/sharding of the dataset buffer.
-        staged = self._maybe_stage_s2d()
-        self._staged_s2d = staged is not None
-        self._data_args = (
-            staged if staged is not None
-            else self.loader.original_data.devmem,
-            self.loader.original_labels.devmem
-            if self.loss_kind == "softmax"
-            else self.loader.original_targets.devmem)
+        self._setup_data_residency()
 
         #: fold confusion accumulation into the eval scan (one forward
         #: sweep serves losses+metrics+confusion) whenever the evaluator
@@ -347,6 +557,10 @@ class FusedTrainer(Logger):
         jit_train = self._compile_train(train_segment)
 
         def _train_segment_call(params_list, opt_states, idx_matrix, keys):
+            if self.streaming:
+                return self._train_segment_streamed(
+                    jit_train, params_list, opt_states, idx_matrix,
+                    keys)
             args = (self._data_args, params_list, opt_states,
                     idx_matrix, keys)
             # abstract shapes are snapshotted BEFORE the jitted call
@@ -392,6 +606,9 @@ class FusedTrainer(Logger):
         jit_eval = self._compile_eval(eval_segment_pure)
 
         def _eval_segment_call(params_list, idx_matrix):
+            if self.streaming:
+                return self._eval_segment_streamed(
+                    jit_eval, params_list, idx_matrix)
             args = (self._data_args, params_list, idx_matrix)
             harvest = self._prepare_harvest("eval_segment", jit_eval,
                                             args)
@@ -460,6 +677,15 @@ class FusedTrainer(Logger):
                 _, confs = jax.lax.scan(body, None, idx_matrix)
                 return jnp.sum(confs, axis=0)
             fn = self._conf_fn = jax.jit(conf_pure)
+        if self.streaming:
+            def run_shard(data_args, local_idx, row0, row1):
+                return fn(data_args, params_list, local_idx)
+            outs = self._stream_segment("eval", run_shard,
+                                        numpy.asarray(idx_matrix))
+            conf = outs[0]
+            for o in outs[1:]:
+                conf = conf + o
+            return conf
         return fn(self._data_args, params_list, jnp.asarray(idx_matrix))
 
     def _dropout_base_key(self):
@@ -482,7 +708,10 @@ class FusedTrainer(Logger):
         Returns ``(losses, metrics, confusion)`` where ``confusion`` is
         None unless it rides the eval scan (``wants_confusion``)."""
         idx = self._segment_indices(klass, skip=skip)
-        out = self._eval_segment(params, jnp.asarray(idx))
+        # streamed mode slices the index matrix on the HOST per shard;
+        # committing it to the device first would be a wasted upload
+        out = self._eval_segment(
+            params, idx if self.streaming else jnp.asarray(idx))
         return out[0], out[1], out[2] if len(out) == 3 else None
 
     def train_class(self, params, states, skip=0):
@@ -497,7 +726,9 @@ class FusedTrainer(Logger):
         first = skip // self.loader.max_minibatch_size
         keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
             jnp.arange(first, first + idx.shape[0]))
-        return self._train_segment(params, states, jnp.asarray(idx), keys)
+        return self._train_segment(
+            params, states, idx if self.streaming else jnp.asarray(idx),
+            keys)
 
     # -- compilation hooks (overridden by parallel trainers) ---------------
     # signatures: train fn(data_args, params, states, idx, keys),
@@ -623,6 +854,7 @@ class FusedTrainer(Logger):
         decision.complete <<= True
         self.workflow.stopped <<= True
         self.push_params(params, states)
+        self.shutdown()
         n_train = self.loader.class_lengths[TRAIN]
         epochs_done = len(decision.epoch_history)
         self.info("fused training: %d epochs in %.2fs (%.0f samples/s)",
