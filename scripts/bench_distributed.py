@@ -155,6 +155,8 @@ def run_master(n_slaves):
         if time.time() > deadline:
             raise RuntimeError("slaves did not connect within 900s")
         time.sleep(0.2)
+    if os.environ.get("VELES_DIST_CHAOS"):
+        _watch_stragglers(launcher)
     elapsed, stamps = _timed_run(launcher, wf)
     rate = _steady_rate(stamps, _samples_per_epoch())
     print("master[%s, %d slaves]: %d epochs in %.1fs, stamps %s"
@@ -166,10 +168,33 @@ def run_master(n_slaves):
         "samples_per_sec": round(rate, 1)}))
 
 
+def _watch_stragglers(launcher):
+    """Chaos leg: announce the first straggler transition on stderr
+    (timestamped with the shared wall clock, so the parent can compute
+    time-to-detection against the moment it paused the slave)."""
+
+    def watch():
+        scorer = launcher._server.health
+        while True:
+            for sid, row in scorer.table().items():
+                if row["state"] == "straggler":
+                    print("EVENT straggler sid=%s t=%.6f score=%.2f"
+                          % (sid, time.time(), row["score"]),
+                          file=sys.stderr, flush=True)
+                    return
+            time.sleep(0.05)
+
+    print("EVENT running t=%.6f" % time.time(), file=sys.stderr,
+          flush=True)
+    threading.Thread(target=watch, daemon=True).start()
+
+
 def run_slave(port):
     from veles_tpu.launcher import Launcher
     launcher = Launcher(master_address="127.0.0.1:%d" % port,
-                        graphics=False)
+                        graphics=False,
+                        heartbeat_interval=float(
+                            os.environ.get("VELES_DIST_HB", 2.0)))
     _build(launcher)
     launcher.initialize()
     launcher.run()
@@ -343,12 +368,19 @@ def _spawn(mode, *args, tpu, extra_env=None, tag=None):
     proc.out_lines = []
     proc.port = None
     proc.port_seen = threading.Event()
+    proc.events = []
 
     def pump_err():
         for line in proc.stderr:
             if line.startswith("PORT="):
                 proc.port = int(line.split("=", 1)[1].strip())
                 proc.port_seen.set()
+            elif line.startswith("EVENT "):
+                # "EVENT <name> k=v ..." announcements (chaos legs)
+                parts = line.split()
+                proc.events.append(
+                    (parts[1], dict(p.split("=", 1) for p in parts[2:]
+                                    if "=" in p)))
             sys.stderr.write("[%s] %s" % (proc.tag, line))
         proc.port_seen.set()  # EOF: unblock _wait_port on early death
 
@@ -459,6 +491,75 @@ def orchestrate_cpu_protocol():
     print(json.dumps(table))
 
 
+def _wait_event(proc, name, timeout):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for event, attrs in list(proc.events):
+            if event == name:
+                return attrs
+        if proc.poll() is not None:
+            raise RuntimeError("%s died (rc=%s) before EVENT %s"
+                               % (proc.tag, proc.returncode, name))
+        time.sleep(0.02)
+    raise RuntimeError("no EVENT %s within %.0fs" % (name, timeout))
+
+
+def orchestrate_chaos_straggler():
+    """``--chaos straggler`` (ROADMAP item 5's first chaos piece):
+    master + 2 CPU slaves on the FC config; once the run is in steady
+    state, SIGSTOP one slave mid-epoch and measure how long the
+    master's health scorer takes to flag it as a straggler. The
+    contract (ISSUE 9): detection within 3 heartbeat intervals (plus a
+    0.75 s grace for signal delivery + evaluation cadence)."""
+    import signal
+
+    hb = float(os.environ.get("VELES_DIST_HB", 0.5))
+    env = {"VELES_DIST_CONFIG": "fc", "VELES_DIST_HB": str(hb),
+           "VELES_DIST_CHAOS": "straggler"}
+    master = _spawn("master", 2, tpu=False, extra_env=env)
+    try:
+        port = _wait_port(master)
+        slaves = [_spawn("slave", port, tpu=False, extra_env=env,
+                         tag="slave%d" % i) for i in range(2)]
+        _wait_event(master, "running", 900)
+        # let the scorer learn each slave's beat cadence (gap EWMA
+        # needs a few observed intervals) and the epoch get going
+        time.sleep(max(4 * hb, 1.0))
+        victim = slaves[1]
+        t_pause = time.time()
+        os.kill(victim.pid, signal.SIGSTOP)
+        try:
+            attrs = _wait_event(master, "straggler", 60)
+        finally:
+            os.kill(victim.pid, signal.SIGCONT)
+        detect_s = float(attrs["t"]) - t_pause
+        intervals = detect_s / hb
+        budget_s = 3 * hb + 0.75
+        report = {"mode": "chaos_straggler", "config": "fc",
+                  "heartbeat_interval_s": hb,
+                  "time_to_detection_s": round(detect_s, 3),
+                  "heartbeat_intervals": round(intervals, 2),
+                  "budget_s": budget_s,
+                  "straggler": attrs.get("sid"),
+                  "score": float(attrs.get("score", 0.0))}
+        print(json.dumps(report))
+        if detect_s > budget_s:
+            raise SystemExit(
+                "straggler detected after %.2fs (> %.2fs = 3 heartbeat "
+                "intervals + grace)" % (detect_s, budget_s))
+        print("chaos straggler leg PASSED: flagged %s in %.2fs "
+              "(%.1f heartbeat intervals)"
+              % (attrs.get("sid"), detect_s, intervals),
+              file=sys.stderr)
+    finally:
+        # detection is the artifact; the paused epoch is not worth
+        # waiting out — tear the legs down
+        for proc in [master] + [s for s in locals().get("slaves", [])]:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
 def orchestrate_chip():
     env = {"VELES_DIST_CONFIG": CONFIG}
     alone = _drain(_spawn("standalone", tpu=True, extra_env=env),
@@ -486,6 +587,11 @@ def main():
         orchestrate_chip()
     elif sys.argv[1] == "--cpu-protocol":
         orchestrate_cpu_protocol()
+    elif sys.argv[1] == "--chaos":
+        kind = sys.argv[2] if len(sys.argv) > 2 else "straggler"
+        if kind != "straggler":
+            raise SystemExit("unknown chaos kind %r" % kind)
+        orchestrate_chaos_straggler()
     elif sys.argv[1] == "standalone":
         run_standalone()
     elif sys.argv[1] == "master":

@@ -139,6 +139,62 @@ def _input_pipeline_probe():
             "input_wait_overlap_ratio": sync_ms / max(deep_ms, 1e-9)}
 
 
+def _federation_probe(n_series=100, beats=50, rounds=3):
+    """ISSUE 9 overhead guard (report-only): heartbeat round-trip with
+    vs. without the federation snapshot piggyback, over a real
+    loopback coordinator pair with a ~2x``n_series``-series slave
+    registry whose series half-churn every beat — a realistic worst
+    case (steady state deltas are far smaller). The ratio keeps the
+    observability plane's cost visible in the perf baseline."""
+    from veles_tpu.parallel.coordinator import (CoordinatorClient,
+                                                CoordinatorServer)
+    from veles_tpu.telemetry.federation import SnapshotEncoder
+    from veles_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    hist = reg.histogram("probe_ms", labels=("op",))
+    gauge = reg.gauge("probe_value", labels=("op",))
+    for i in range(n_series):
+        hist.labels(op="op%d" % i).observe(1.0)
+        gauge.labels(op="op%d" % i).set(float(i))
+
+    server = CoordinatorServer(checksum="fedprobe")
+    try:
+        client = CoordinatorClient(server.address, checksum="fedprobe",
+                                   heartbeat_interval=3600.0,
+                                   federate=False)
+        client.connect()
+        proto = client._hb_proto
+        encoder = SnapshotEncoder(registry=reg)
+        encoder.encode()  # prime: steady-state deltas, not full pushes
+
+        def run_leg(with_telemetry):
+            total = 0.0
+            for i in range(beats):
+                if with_telemetry:
+                    # churn half the series so every delta is honest
+                    for j in range(0, n_series, 2):
+                        hist.labels(op="op%d" % j).observe(float(i))
+                msg = {"cmd": "heartbeat", "power": 1.0, "rtt_ms": 1.0}
+                t0 = time.perf_counter()
+                if with_telemetry:
+                    delta = encoder.encode()
+                    if delta is not None:
+                        msg["telemetry"] = delta
+                proto.send(msg)
+                proto.recv()
+                total += time.perf_counter() - t0
+            return total / beats
+
+        run_leg(False)  # warm the path
+        base = min(run_leg(False) for _ in range(rounds))
+        fed = min(run_leg(True) for _ in range(rounds))
+        client.close()
+    finally:
+        server.stop()
+    return {"federation_overhead_ratio": fed / max(base, 1e-9)}
+
+
 def capture():
     """Run the probe and return the snapshot dict."""
     from veles_tpu.telemetry import profiler
@@ -170,6 +226,7 @@ def capture():
     if rss:
         metrics["host_rss_gb"] = rss / 2.0 ** 30
     metrics.update(_input_pipeline_probe())
+    metrics.update(_federation_probe())
     return {"schema": "veles-perf-snapshot/1",
             "probe": {"samples": SAMPLES, "batch": BATCH,
                       "epochs": EPOCHS, "seed": SEED},

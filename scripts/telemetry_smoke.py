@@ -13,7 +13,12 @@ Drives the REAL surfaces end-to-end, cheaply:
 3. with ``--flight``: trains the same tiny model with a NaN injected
    into the training data and asserts the flight recorder left a
    loadable record naming the offending sweep (the CI smoke for the
-   black box — this mode runs INSTEAD of the default checks).
+   black box — this mode runs INSTEAD of the default checks);
+4. with ``--cluster`` (ISSUE 9): starts a master + 2 in-process slaves
+   and a dashboard, scrapes the FEDERATED ``/metrics`` +
+   ``/cluster.json`` and asserts per-slave series are present while
+   the slaves live and garbage-collected after a clean disconnect
+   (this mode also runs INSTEAD of the default checks).
 
 Exit code 0 = the exercised surfaces are alive. Runs on CPU in a few
 seconds.
@@ -140,7 +145,124 @@ def check_flight_record(tmpdir):
           % (jsons[0], record["reason"], record["context"]["step"]))
 
 
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=5) as resp:
+        assert resp.status == 200, (path, resp.status)
+        return resp.read().decode()
+
+
+def check_cluster():
+    import threading
+    import time
+
+    import numpy
+
+    from veles_tpu import prng
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.models.mnist import MnistWorkflow
+    from veles_tpu.telemetry import federation
+    from veles_tpu.web_status import WebStatusServer
+
+    def provider():
+        rng = numpy.random.RandomState(0)
+        x = rng.rand(120, 6, 6).astype(numpy.float32)
+        y = (x.reshape(120, -1).sum(1) > 18).astype(numpy.int32)
+        return x[:100], y[:100], x[100:], y[100:]
+
+    def make(launcher):
+        return MnistWorkflow(launcher, provider=provider, layers=(8,),
+                             minibatch_size=20, max_epochs=2)
+
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    master = Launcher(listen_address="127.0.0.1:0", graphics=False)
+    make(master)
+    master.initialize()
+    port = master._server.address[1]
+    slaves = []
+    for _ in range(2):
+        prng.get().seed(42)
+        prng.get("loader").seed(43)
+        slave = Launcher(master_address="127.0.0.1:%d" % port,
+                         graphics=False, eager=True,
+                         heartbeat_interval=0.1)
+        make(slave)
+        slave.initialize()
+        slaves.append(slave)
+    sids = sorted(s._client.id for s in slaves)
+
+    dashboard = WebStatusServer(host="127.0.0.1", port=0).start()
+    base = "http://127.0.0.1:%d" % dashboard.port
+    try:
+        # slaves heartbeat from initialize() on — wait for both feeds
+        deadline = time.time() + 30
+        while sorted(federation.get_federation().slaves()) != sids:
+            assert time.time() < deadline, \
+                "slave feeds never arrived: %s" \
+                % federation.get_federation().slaves()
+            time.sleep(0.05)
+        # the master's OWN per-slave families (RTT, exchange, job
+        # times) outlive a clean disconnect by design — end-of-run
+        # snapshots still read them. Only series the slaves PUSHED
+        # (the federated feed) must appear now and vanish on GC.
+        master_prefixes = ("veles_slave_", "veles_exchange_",
+                           "veles_jobs_total", "veles_job_source_ms",
+                           "veles_result_sink_ms",
+                           "veles_cluster_flight_notices_total")
+
+        def federated_lines(text, sid):
+            return [line for line in text.splitlines()
+                    if 'slave="%s"' % sid in line and
+                    not line.startswith(master_prefixes)]
+
+        text = _get(base, "/metrics")
+        for sid in sids:
+            assert federated_lines(text, sid), \
+                "no federated series for %s:\n%s" % (sid, text[:2000])
+        cluster = json.loads(_get(base, "/cluster.json"))
+        assert sorted(cluster["slaves"]) == sids, cluster
+        for sid in sids:
+            assert cluster["slaves"][sid]["telemetry"]["seq"] >= 1
+        assert cluster["run"].get("trace_id") == master._server.trace_id
+        print("cluster view OK: 2 slave feeds federated, "
+              "/cluster.json lists %s" % ", ".join(sids))
+
+        # run the tiny distributed job to completion, then the clean
+        # disconnects must GC both feeds
+        threads = [threading.Thread(target=s.run, daemon=True)
+                   for s in slaves]
+        for t in threads:
+            t.start()
+        master.run()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "slave hung"
+        deadline = time.time() + 10
+        while federation.get_federation().slaves():
+            assert time.time() < deadline, \
+                "feeds not GC'd: %s" % federation.get_federation().slaves()
+            time.sleep(0.05)
+        text = _get(base, "/metrics")
+        for sid in sids:
+            assert not federated_lines(text, sid), \
+                "federated series for disconnected %s survived GC:\n%s" \
+                % (sid, "\n".join(federated_lines(text, sid)[:5]))
+        cluster = json.loads(_get(base, "/cluster.json"))
+        assert not cluster["slaves"], cluster
+        print("cluster GC OK: per-slave series gone after clean "
+              "disconnect")
+    finally:
+        dashboard.stop()
+        for s in slaves:
+            s.stop()
+        master.stop()
+
+
 def main():
+    if "--cluster" in sys.argv:
+        check_cluster()
+        print("cluster observability smoke PASSED")
+        return 0
     if "--flight" in sys.argv:
         with tempfile.TemporaryDirectory() as tmpdir:
             check_flight_record(tmpdir)

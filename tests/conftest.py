@@ -94,6 +94,7 @@ def _stop_telemetry_threads():
     # "terminate called without an active exception" window
     from veles_tpu.loader import prefetch
     prefetch.shutdown_all()
-    from veles_tpu.telemetry import flight, profiler
+    from veles_tpu.telemetry import alerts, flight, profiler
+    alerts.reset_engine()
     flight.reset_recorder()
     profiler.stop_memory_sampler()

@@ -1,0 +1,315 @@
+"""SLO alert engine + health/straggler scorer (ISSUE 9): threshold
+hysteresis, multi-window burn rate, increase rules, and peer-relative
+straggler scoring that cannot flap on a single slow job."""
+
+import json
+
+import pytest
+
+from veles_tpu.telemetry.alerts import AlertEngine, Rule
+from veles_tpu.telemetry.health import HealthScorer
+from veles_tpu.telemetry.registry import MetricsRegistry
+
+
+def _engine(reg, *rules):
+    return AlertEngine(registry=reg, rules=list(rules),
+                       min_eval_interval_s=0.0)
+
+
+def _active(reg, rule):
+    gauge = reg.get("veles_alerts_active")
+    for labels, child in gauge.series():
+        if labels["rule"] == rule:
+            return child.value
+    return None
+
+
+# -- rule validation --------------------------------------------------------
+
+
+def test_unknown_rule_key_rejected():
+    with pytest.raises(ValueError, match="unknown keys"):
+        Rule.from_dict({"name": "x", "metric": "m", "threshold": 1,
+                        "treshold": 2})
+
+
+def test_rule_kind_and_field_validation():
+    with pytest.raises(ValueError):
+        Rule("x", kind="nope", metric="m", threshold=1)
+    with pytest.raises(ValueError):
+        Rule("x", metric="m")  # threshold missing
+    with pytest.raises(ValueError):
+        Rule("x", kind="burn_rate", numerator="n")  # denominator missing
+    with pytest.raises(ValueError):
+        Rule("x", metric="m", threshold=1, op="!=")
+
+
+def test_rules_file_loading(tmp_path):
+    reg = MetricsRegistry()
+    engine = _engine(reg)
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps({"rules": [
+        {"name": "custom_depth", "metric": "q_depth",
+         "threshold": 3.0}]}))
+    engine.load_rules(str(path))
+    assert "custom_depth" in [r["name"]
+                              for r in engine.report(evaluate=False)
+                              ["rules"]]
+
+
+# -- threshold rules --------------------------------------------------------
+
+
+def test_threshold_rule_fires_and_clears_with_hysteresis():
+    reg = MetricsRegistry()
+    depth = reg.gauge("q_depth")
+    engine = _engine(reg, {"name": "deep", "metric": "q_depth",
+                           "op": ">", "threshold": 10.0, "for_s": 2.0,
+                           "clear_for_s": 2.0})
+    t = 1000.0
+    depth.set(50)
+    engine.evaluate(now=t)
+    assert engine.active() == []          # breaching, but not for 2 s
+    engine.evaluate(now=t + 1.0)
+    assert engine.active() == []
+    engine.evaluate(now=t + 2.5)
+    assert engine.active() == ["deep"]    # sustained breach fires
+    assert _active(reg, "deep") == 1.0
+
+    # a momentary dip must NOT clear it (hysteresis both ways)
+    depth.set(5)
+    engine.evaluate(now=t + 3.0)
+    assert engine.active() == ["deep"]
+    depth.set(50)
+    engine.evaluate(now=t + 4.0)
+    depth.set(5)
+    engine.evaluate(now=t + 5.0)
+    engine.evaluate(now=t + 7.5)          # clear held for 2.5 s
+    assert engine.active() == []
+    assert _active(reg, "deep") == 0.0
+    transitions = reg.get("veles_alerts_transitions_total")
+    counts = {labels["to"]: child.value
+              for labels, child in transitions.series()}
+    assert counts == {"firing": 1.0, "clear": 1.0}
+
+
+def test_threshold_spike_shorter_than_for_s_never_fires():
+    reg = MetricsRegistry()
+    depth = reg.gauge("q_depth")
+    engine = _engine(reg, {"name": "deep", "metric": "q_depth",
+                           "op": ">", "threshold": 10.0, "for_s": 2.0})
+    t = 1000.0
+    depth.set(50)
+    engine.evaluate(now=t)                # breach starts
+    depth.set(1)
+    engine.evaluate(now=t + 1.0)          # ...and ends within for_s
+    depth.set(50)
+    engine.evaluate(now=t + 1.5)          # a NEW breach window starts
+    engine.evaluate(now=t + 3.0)
+    assert engine.active() == []          # 1.5 s < for_s: still quiet
+    engine.evaluate(now=t + 3.6)
+    assert engine.active() == ["deep"]
+
+
+def test_threshold_labels_agg_and_histogram_field():
+    reg = MetricsRegistry()
+    lat = reg.histogram("lat_ms", labels=("endpoint",))
+    for _ in range(20):
+        lat.labels(endpoint="/api").observe(900.0)
+        lat.labels(endpoint="/health").observe(1.0)
+    engine = _engine(reg, {"name": "api_slow", "metric": "lat_ms",
+                           "labels": {"endpoint": "/api"},
+                           "field": "p95", "op": ">",
+                           "threshold": 500.0})
+    engine.evaluate(now=1000.0)
+    assert engine.active() == ["api_slow"]
+    # missing series -> no data -> never fires
+    engine2 = _engine(reg, {"name": "ghost", "metric": "nope",
+                            "threshold": 1.0})
+    engine2.evaluate(now=1000.0)
+    assert engine2.active() == []
+
+
+# -- increase / burn-rate rules --------------------------------------------
+
+
+def test_increase_rule_fires_on_counter_movement():
+    reg = MetricsRegistry()
+    trips = reg.counter("trips_total", labels=("detector",))
+    trips.labels(detector="nan").inc(0)
+    engine = _engine(reg, {"name": "nan_seen", "kind": "increase",
+                           "metric": "trips_total",
+                           "labels": {"detector": "nan"},
+                           "window_s": 10.0, "threshold": 0.0})
+    t = 1000.0
+    for i in range(12):                   # build window-deep history
+        engine.evaluate(now=t + i)
+    assert engine.active() == []
+    trips.labels(detector="nan").inc()
+    engine.evaluate(now=t + 12)
+    assert engine.active() == ["nan_seen"]
+
+
+def test_burn_rate_multi_window_fire_and_clear():
+    reg = MetricsRegistry()
+    bad = reg.counter("rejected_total")
+    total = reg.counter("requests_total")
+    engine = _engine(reg, {
+        "name": "shed_burn", "kind": "burn_rate",
+        "numerator": "rejected_total", "denominator": "requests_total",
+        "objective": 0.01, "windows": [[10.0, 5.0], [30.0, 3.0]]})
+    t = 1000.0
+    # 40 s of clean traffic: builds history spanning BOTH windows
+    for i in range(40):
+        total.inc(10)
+        engine.evaluate(now=t + i)
+    assert engine.active() == []
+    # short window burns hot but the long window is still clean ->
+    # multi-window logic holds fire (20% errors: the 30 s window only
+    # crosses its 3x factor after ~5 hot seconds)
+    for i in range(40, 44):
+        total.inc(10)
+        bad.inc(2)                        # 20% errors = 20x objective
+        engine.evaluate(now=t + i)
+        assert engine.active() == [], "fired on the short window alone"
+    # keep burning: once the 30 s window crosses 3x too, it fires
+    fired_at = None
+    for i in range(44, 90):
+        total.inc(10)
+        bad.inc(2)
+        engine.evaluate(now=t + i)
+        if engine.active() and fired_at is None:
+            fired_at = i
+    assert fired_at is not None, "burn-rate rule never fired"
+    # recovery: clean traffic drains the short window first
+    for i in range(90, 140):
+        total.inc(10)
+        engine.evaluate(now=t + i)
+    assert engine.active() == []
+
+
+def test_add_rule_replacement_resets_state():
+    reg = MetricsRegistry()
+    reg.gauge("q_depth").set(99)
+    trips = reg.counter("trips_total")
+    engine = _engine(reg, {"name": "r", "metric": "q_depth",
+                           "op": ">", "threshold": 10.0})
+    engine.evaluate(now=1000.0)
+    assert engine.active() == ["r"]
+    # replace with a DIFFERENT kind under the same name: the old
+    # firing flag and sample history must not leak into the new rule
+    engine.add_rule({"name": "r", "kind": "increase",
+                     "metric": "trips_total", "window_s": 5.0})
+    assert engine.active() == []
+    for i in range(8):                    # evaluates cleanly (no stale
+        engine.evaluate(now=1001.0 + i)   # 2-tuple/3-tuple mixups)
+    assert engine.active() == []
+    trips.inc()
+    engine.evaluate(now=1010.0)
+    assert engine.active() == ["r"]
+
+
+def test_report_shape():
+    reg = MetricsRegistry()
+    reg.gauge("q_depth").set(99)
+    engine = _engine(reg, {"name": "deep", "metric": "q_depth",
+                           "op": ">", "threshold": 10.0})
+    engine.evaluate(now=1000.0)
+    report = engine.report(evaluate=False)
+    assert json.loads(json.dumps(report)) == report
+    (rule,) = report["rules"]
+    assert rule["name"] == "deep" and rule["firing"] is True
+    assert rule["value"] == 99.0
+    assert report["transitions"][0]["to"] == "firing"
+
+
+# -- health scorer ----------------------------------------------------------
+
+
+def _scored(registry=None, **kw):
+    return HealthScorer(registry=registry or MetricsRegistry(), **kw)
+
+
+def test_single_slow_job_does_not_flap():
+    scorer = _scored()
+    t = 1000.0
+    for i in range(10):
+        scorer.observe("fast", job_ms=100.0, now=t + i)
+        scorer.observe("slow", job_ms=100.0, now=t + i)
+        scorer.evaluate(now=t + i, force=True)
+    # ONE pathological job (100x) — the EWMA spikes, but the streak
+    # guard keeps the job component from scoring
+    scorer.observe("slow", job_ms=10000.0, now=t + 10)
+    for i in range(11, 20):
+        scorer.evaluate(now=t + i, force=True)
+        assert scorer.state("slow") == "healthy", \
+            scorer.table()["slow"]
+    # and a normal job resets the streak entirely
+    scorer.observe("slow", job_ms=100.0, now=t + 20)
+    scorer.evaluate(now=t + 20, force=True)
+    assert scorer.state("slow") == "healthy"
+
+
+def test_sustained_slow_jobs_flag_straggler_and_recovery():
+    reg = MetricsRegistry()
+    scorer = _scored(registry=reg)
+    t = 1000.0
+    for i in range(10):
+        scorer.observe("fast", job_ms=100.0, now=t + i)
+        scorer.observe("slow", job_ms=100.0, now=t + i)
+        scorer.evaluate(now=t + i, force=True)
+    # consistently 10x the peer median -> straggler within a few evals
+    for i in range(10, 14):
+        scorer.observe("fast", job_ms=100.0, now=t + i)
+        scorer.observe("slow", job_ms=1000.0, now=t + i)
+        scorer.evaluate(now=t + i, force=True)
+    assert scorer.state("slow") == "straggler"
+    table = scorer.table()["slow"]
+    assert table["components"]["job_ms"] > 2.0
+    state = {labels["slave"]: child.value for labels, child in
+             reg.get("veles_slave_health_state").series()}
+    assert state == {"fast": 0.0, "slow": 1.0}
+    # recovery needs the EXIT bar held for exit_evals evaluations
+    for i in range(14, 40):
+        scorer.observe("fast", job_ms=100.0, now=t + i)
+        scorer.observe("slow", job_ms=100.0, now=t + i)
+        scorer.evaluate(now=t + i, force=True)
+        if scorer.state("slow") == "healthy":
+            break
+    assert scorer.state("slow") == "healthy"
+    transitions = scorer.transitions()
+    assert [tr["to"] for tr in transitions] == ["straggler", "healthy"]
+
+
+def test_silence_flags_within_three_intervals():
+    scorer = _scored()
+    t = 1000.0
+    interval = 0.5
+    for i in range(6):                    # both slaves beat on cadence
+        scorer.observe("a", beat=True, rtt_ms=1.0, now=t + i * interval)
+        scorer.observe("b", beat=True, rtt_ms=1.0, now=t + i * interval)
+        scorer.evaluate(now=t + i * interval, force=True)
+    # "b" pauses; "a" keeps beating and driving evaluations
+    pause = t + 6 * interval
+    flagged = None
+    for i in range(6, 16):
+        now = t + i * interval
+        scorer.observe("a", beat=True, rtt_ms=1.0, now=now)
+        scorer.evaluate(now=now, force=True)
+        if scorer.state("b") == "straggler":
+            flagged = now - pause
+            break
+    assert flagged is not None, scorer.table()
+    assert flagged <= 3 * interval, flagged
+
+
+def test_remove_gcs_gauges():
+    reg = MetricsRegistry()
+    scorer = _scored(registry=reg)
+    scorer.observe("a", beat=True, now=1000.0)
+    scorer.evaluate(now=1000.0, force=True)
+    assert reg.get("veles_slave_health_state").series()
+    assert scorer.remove("a")
+    assert scorer.table() == {}
+    assert reg.get("veles_slave_health_state").series() == []
+    assert reg.get("veles_slave_health_score").series() == []

@@ -1,0 +1,526 @@
+"""Cluster observability plane (ISSUE 9): snapshot-delta federation,
+registry GC, heartbeat piggyback, and distributed flight-record
+correlation."""
+
+import json
+import re
+import time
+
+import pytest
+
+from veles_tpu.telemetry import federation
+from veles_tpu.telemetry.federation import (FederatedRegistry,
+                                            SnapshotEncoder)
+from veles_tpu.telemetry.registry import MetricsRegistry, get_registry
+
+
+@pytest.fixture
+def singletons():
+    """Fresh federation/health/alert singletons, reset afterwards (the
+    coordinator wires itself onto them)."""
+    from veles_tpu.telemetry import alerts, health
+    federation.reset_federation()
+    health.reset_scorer()
+    alerts.reset_engine()
+    try:
+        yield
+    finally:
+        federation.reset_federation()
+        health.reset_scorer()
+        alerts.reset_engine()
+
+
+def _fed(**kwargs):
+    return FederatedRegistry(registry=MetricsRegistry(), **kwargs)
+
+
+def _value(fed, sid, name, labels=()):
+    for row_sid, tag, row_name, row_labels, data in fed.series_rows():
+        if row_sid == sid and row_name == name and \
+                row_labels == dict(labels):
+            return data
+    return None
+
+
+# -- registry GC API --------------------------------------------------------
+
+
+def test_family_remove_exact_and_subset():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", labels=("slave", "direction"))
+    c.labels(slave="a", direction="in").inc()
+    c.labels(slave="a", direction="out").inc()
+    c.labels(slave="b", direction="in").inc()
+    # exact removal
+    assert c.remove(slave="b", direction="in") == 1
+    # subset removal clears every matching child
+    assert c.remove(slave="a") == 2
+    assert c.series() == []
+    # unknown label names are a programming error, not a no-op
+    with pytest.raises(ValueError):
+        c.remove(nope="x")
+    # removing the already-removed is a harmless 0
+    assert c.remove(slave="a") == 0
+
+
+# -- delta encoding ---------------------------------------------------------
+
+
+def test_delta_roundtrip_and_incremental():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", labels=("kind",))
+    g = reg.gauge("depth")
+    h = reg.histogram("lat_ms")
+    c.labels(kind="a").inc(3)
+    g.set(7)
+    h.observe(2.0)
+    h.observe(4.0)
+    enc = SnapshotEncoder(registry=reg)
+    fed = _fed()
+
+    first = enc.encode()
+    assert first["full"] and first["seq"] == 1
+    assert json.loads(json.dumps(first)) == first  # wire-safe
+    assert fed.apply("s1", first) == {}
+    assert _value(fed, "s1", "jobs_total", {"kind": "a"}) == 3.0
+    assert _value(fed, "s1", "depth") == 7.0
+    assert _value(fed, "s1", "lat_ms")["count"] == 2
+
+    # nothing changed -> no payload at all rides the heartbeat
+    assert enc.encode() is None
+
+    # only the changed series ride the next delta
+    c.labels(kind="a").inc(2)
+    second = enc.encode()
+    assert second["seq"] == 2 and "full" not in second
+    assert [row[1] for row in second["series"]] == ["jobs_total"]
+    fed.apply("s1", second)
+    assert _value(fed, "s1", "jobs_total", {"kind": "a"}) == 5.0
+    assert _value(fed, "s1", "depth") == 7.0  # untouched series kept
+
+
+def test_removed_series_tombstones():
+    reg = MetricsRegistry()
+    c = reg.counter("jobs_total", labels=("kind",))
+    c.labels(kind="a").inc()
+    c.labels(kind="b").inc()
+    enc = SnapshotEncoder(registry=reg)
+    fed = _fed()
+    fed.apply("s1", enc.encode())
+    assert _value(fed, "s1", "jobs_total", {"kind": "b"}) == 1.0
+    c.remove(kind="b")
+    c.labels(kind="a").inc()
+    delta = enc.encode()
+    assert delta["removed"] == [["jobs_total", {"kind": "b"}]]
+    fed.apply("s1", delta)
+    assert _value(fed, "s1", "jobs_total", {"kind": "b"}) is None
+    assert _value(fed, "s1", "jobs_total", {"kind": "a"}) == 2.0
+
+
+def test_counter_monotonic_across_slave_restart():
+    fed = _fed()
+    reg1 = MetricsRegistry()
+    reg1.counter("done_total").inc(10)
+    fed.apply("s1", SnapshotEncoder(registry=reg1).encode())
+    assert _value(fed, "s1", "done_total") == 10.0
+
+    # the slave process restarts behind the same sid: new encoder,
+    # seq back to 1, counter back to a small raw value — the federated
+    # counter must keep increasing, never jump backwards
+    reg2 = MetricsRegistry()
+    reg2.counter("done_total").inc(3)
+    enc2 = SnapshotEncoder(registry=reg2)
+    fed.apply("s1", enc2.encode())
+    assert _value(fed, "s1", "done_total") == 13.0
+    reg2.get("done_total").inc(4)
+    fed.apply("s1", enc2.encode())
+    assert _value(fed, "s1", "done_total") == 17.0
+
+
+def test_duplicate_delta_is_idempotent():
+    reg = MetricsRegistry()
+    counter = reg.counter("done_total")
+    counter.inc(5)
+    enc = SnapshotEncoder(registry=reg)
+    fed = _fed()
+    first = enc.encode()
+    fed.apply("s1", first)
+    counter.inc(1)
+    second = enc.encode()
+    fed.apply("s1", second)
+    assert _value(fed, "s1", "done_total") == 6.0
+    # the network re-delivers both: merged state must not move (and a
+    # replayed LOWER absolute value must not register as a "restart")
+    fed.apply("s1", dict(first))
+    fed.apply("s1", dict(second))
+    assert _value(fed, "s1", "done_total") == 6.0
+    dup = fed._registry.get("veles_federation_duplicates_total")
+    assert dup.value >= 2
+
+
+def test_gap_requests_resync_and_full_heals():
+    reg = MetricsRegistry()
+    gauge = reg.gauge("depth")
+    gauge.set(1)
+    enc = SnapshotEncoder(registry=reg)
+    fed = _fed()
+    assert fed.apply("s1", enc.encode()) == {}
+    gauge.set(2)
+    enc.encode()  # this delta is LOST in transit
+    gauge.set(3)
+    hints = fed.apply("s1", enc.encode())  # seq jumps 1 -> 3
+    assert hints == {"resync": True}
+    # the resync request PERSISTS until a full push actually arrives
+    # (one lost ack must not leave the view stale forever)
+    gauge.set(4)
+    assert fed.apply("s1", enc.encode()) == {"resync": True}
+    # the slave reacts like the heartbeat loop would
+    enc.mark_resync()
+    full = enc.encode()
+    assert full["full"]
+    assert fed.apply("s1", full) == {}
+    assert _value(fed, "s1", "depth") == 4.0
+
+
+def test_fresh_feed_joining_midstream_requests_resync():
+    """A feed re-created after a drop (or promoted past the slave cap)
+    whose first delta is NOT full is missing every series that stopped
+    churning earlier — it must ask for a full push."""
+    reg = MetricsRegistry()
+    gauge = reg.gauge("depth")
+    gauge.set(1)
+    enc = SnapshotEncoder(registry=reg)
+    fed = _fed()
+    fed.apply("s1", enc.encode())
+    fed.remove_slave("s1")  # the drop/apply race GC'd the feed
+    gauge.set(2)
+    assert fed.apply("s1", enc.encode()) == {"resync": True}
+    enc.mark_resync()
+    assert fed.apply("s1", enc.encode()) == {}
+
+
+def test_series_cardinality_cap():
+    reg = MetricsRegistry()
+    g = reg.gauge("many", labels=("i",))
+    for i in range(8):
+        g.labels(i=str(i)).set(i)
+    fed = _fed(max_series_per_slave=5)
+    fed.apply("s1", SnapshotEncoder(registry=reg).encode())
+    assert fed.slaves()["s1"]["series"] == 5
+    assert fed._registry.get(
+        "veles_federation_dropped_series_total").value == 3
+
+
+def test_remove_slave_gcs_feed():
+    reg = MetricsRegistry()
+    reg.gauge("depth").set(1)
+    fed = _fed()
+    fed.apply("s1", SnapshotEncoder(registry=reg).encode())
+    assert "s1" in fed.slaves()
+    assert fed.remove_slave("s1")
+    assert fed.slaves() == {}
+    assert not fed.remove_slave("s1")
+
+
+# -- rendering --------------------------------------------------------------
+
+
+_PROM_LINE = re.compile(
+    r'^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+|'
+    r'[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(?:\{[a-zA-Z0-9_]+="(?:[^"\\]|\\.)*"'
+    r'(?:,[a-zA-Z0-9_]+="(?:[^"\\]|\\.)*")*\})?'
+    r' -?[0-9.]+(?:[eE][+-]?[0-9]+)?)$')
+
+
+def test_merged_snapshot_and_prometheus_render():
+    slave_reg = MetricsRegistry()
+    slave_reg.counter("veles_jobs_done_total", "jobs").inc(4)
+    hist = slave_reg.histogram("veles_f_step_ms", "steps",
+                               labels=("phase",))
+    for i in range(10):
+        hist.labels(phase="train").observe(float(i))
+    local = MetricsRegistry()
+    local.gauge("veles_f_local_gauge", "local").set(1.0)
+    fed = FederatedRegistry(registry=local)
+    fed.apply("ab12", SnapshotEncoder(registry=slave_reg).encode())
+
+    snap = fed.merged_snapshot(local)
+    jobs = snap["counters"]["veles_jobs_done_total"]["series"]
+    assert jobs[0]["labels"] == {"slave": "ab12"}
+    assert jobs[0]["value"] == 4.0
+    steps = snap["histograms"]["veles_f_step_ms"]["series"][0]
+    assert steps["labels"] == {"phase": "train", "slave": "ab12"}
+    assert steps["count"] == 10
+
+    # a pushed series that ALREADY carries a slave label (in-process
+    # master+slave, master-under-master) keeps its attribution under
+    # the Prometheus exported_* convention instead of being rewritten
+    inner = slave_reg.histogram("veles_f_rtt_ms", labels=("slave",))
+    inner.labels(slave="inner1").observe(1.0)
+    fed.apply("ab12", SnapshotEncoder(registry=slave_reg).encode())
+    nested = fed.merged_snapshot(local)["histograms"]["veles_f_rtt_ms"]
+    assert nested["series"][0]["labels"] == {
+        "exported_slave": "inner1", "slave": "ab12"}
+
+    text = federation.render_snapshot_prometheus(snap)
+    for line in text.strip().split("\n"):
+        assert _PROM_LINE.match(line), "bad exposition line: %r" % line
+    assert 'veles_jobs_done_total{slave="ab12"} 4.0' in text
+    assert 'veles_f_step_ms_count{phase="train",slave="ab12"} 10' in text
+    assert "veles_f_local_gauge 1.0" in text
+
+
+# -- the heartbeat piggyback over a real socket -----------------------------
+
+
+def test_heartbeat_piggyback_over_socket(singletons):
+    from veles_tpu.parallel.coordinator import (CoordinatorClient,
+                                                CoordinatorServer)
+
+    marker = get_registry().counter("veles_fedtest_marker_total")
+    marker.inc(11)
+    server = CoordinatorServer(checksum="f")
+    client = None
+    try:
+        client = CoordinatorClient(server.address, checksum="f",
+                                   heartbeat_interval=0.05).connect()
+        sid = client.id
+        deadline = time.time() + 10
+        while sid not in server.federation.slaves():
+            assert time.time() < deadline, "no feed arrived"
+            time.sleep(0.02)
+        # the marker series crossed the heartbeat channel and shows up
+        # slave-labeled in the merged cluster view
+        deadline = time.time() + 10
+        while True:
+            snap = server.federation.merged_snapshot()
+            series = snap["counters"].get(
+                "veles_fedtest_marker_total", {}).get("series", [])
+            fed_rows = [s for s in series
+                        if s.get("labels", {}).get("slave") == sid]
+            if fed_rows:
+                assert fed_rows[0]["value"] >= 11.0
+                break
+            assert time.time() < deadline, "marker never federated"
+            time.sleep(0.02)
+        # health sees the beats too
+        assert server.health.table()[sid]["state"] == "healthy"
+    finally:
+        if client is not None:
+            client.close()
+        server.stop()
+    # GC on disconnect
+    deadline = time.time() + 10
+    while server.federation.slaves():
+        assert time.time() < deadline, "feed survived disconnect"
+        time.sleep(0.02)
+
+
+def test_flight_notice_reaches_master(singletons, tmp_path):
+    """A slave flight-record dump -> notify_flight -> the master's
+    on_slave_flight callback, within about one (woken) heartbeat."""
+    from veles_tpu.parallel.coordinator import (CoordinatorClient,
+                                                CoordinatorServer)
+    from veles_tpu.telemetry.flight import FlightRecorder
+
+    received = []
+    server = CoordinatorServer(
+        checksum="f",
+        on_slave_flight=lambda sid, notice: received.append(
+            (sid, notice)))
+    client = None
+    recorder = FlightRecorder(out_dir=str(tmp_path),
+                              min_dump_interval_s=0.0)
+    try:
+        client = CoordinatorClient(server.address, checksum="f",
+                                   heartbeat_interval=5.0).connect()
+        recorder.add_dump_listener(
+            lambda reason, path, ctx: client.notify_flight(
+                reason, path, ctx))
+        t0 = time.time()
+        path = recorder.dump("non_finite_loss", step="epoch 0 batch 3")
+        assert path is not None
+        deadline = time.time() + 10
+        while not received:
+            assert time.time() < deadline, "notice never arrived"
+            time.sleep(0.02)
+        latency = time.time() - t0
+        sid, notice = received[0]
+        assert sid == client.id
+        assert notice["reason"] == "non_finite_loss"
+        assert notice["path"] == path
+        assert notice["trace_id"] == server.trace_id
+        assert notice["context"]["step"] == "epoch 0 batch 3"
+        # notify_flight WAKES the beat loop: no 5 s interval wait
+        assert latency < 3.0, latency
+    finally:
+        recorder.stop()
+        if client is not None:
+            client.close()
+        server.stop()
+
+
+# -- launcher-level correlation + the 2-slave acceptance run ----------------
+
+
+def _tiny_mnist(launcher):
+    import numpy
+
+    from veles_tpu.models.mnist import MnistWorkflow
+
+    def provider():
+        rng = numpy.random.RandomState(0)
+        x = rng.rand(120, 6, 6).astype(numpy.float32)
+        y = (x.reshape(120, -1).sum(1) > 18).astype(numpy.int32)
+        return x[:100], y[:100], x[100:], y[100:]
+
+    return MnistWorkflow(launcher, provider=provider, layers=(8,),
+                         minibatch_size=20, max_epochs=2)
+
+
+def test_slave_flight_trips_cluster_record(singletons, tmp_path,
+                                           monkeypatch):
+    """An injected failure on a slave yields ONE cluster flight record
+    on the master, carrying the run's shared trace id and the
+    per-slave health table."""
+    from veles_tpu import prng
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.telemetry import flight
+
+    monkeypatch.setenv("VELES_FLIGHT_DIR", str(tmp_path))
+    flight.reset_recorder()
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    master = Launcher(listen_address="127.0.0.1:0", graphics=False)
+    _tiny_mnist(master)
+    master.initialize()
+    slave = None
+    try:
+        port = master._server.address[1]
+        slave = Launcher(master_address="127.0.0.1:%d" % port,
+                         graphics=False, heartbeat_interval=0.1)
+        _tiny_mnist(slave)
+        slave.initialize()
+        sid = slave._client.id
+        # the slave's detector trips (what FusedRunner.check_losses
+        # does on a NaN sweep); in-process master and slave share the
+        # recorder singleton — exactly the recursion case the
+        # cluster_ guard exists for
+        flight.get_recorder().dump("non_finite_loss", epoch=0, batch=3,
+                                   step="epoch 0 batch 3")
+        deadline = time.time() + 15
+        cluster_records = []
+        while not cluster_records:
+            assert time.time() < deadline, \
+                "no cluster record: %s" % sorted(
+                    p.name for p in tmp_path.iterdir())
+            cluster_records = [p for p in tmp_path.iterdir()
+                               if "cluster_non_finite_loss" in p.name
+                               and p.name.endswith(".json")]
+            time.sleep(0.05)
+        # ...and it stays ONE correlated artifact (rate-limited), not
+        # a recursing or per-notice pile
+        time.sleep(0.5)
+        cluster_records = [p for p in tmp_path.iterdir()
+                           if "cluster_" in p.name
+                           and p.name.endswith(".json")]
+        assert len(cluster_records) == 1, cluster_records
+        record = flight.load_record(str(cluster_records[0]))
+        context = record["context"]
+        assert context["slave"] == sid
+        assert context["trace_id"] == master._server.trace_id
+        assert sid in context["cluster"]["slaves"]
+        assert context["slave_record"]  # names the slave's own file
+    finally:
+        if slave is not None:
+            slave.stop()
+        master.stop()
+        flight.reset_recorder()
+
+
+def test_two_slave_acceptance_cluster_and_straggler(singletons):
+    """ISSUE 9 acceptance: a 2-slave run exposes /cluster.json with
+    both slaves; silencing one flips it to straggler within a few
+    heartbeat intervals and raises veles_alerts_active."""
+    import urllib.request
+
+    from veles_tpu import prng
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.web_status import WebStatusServer
+
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    master = Launcher(listen_address="127.0.0.1:0", graphics=False,
+                      heartbeat_timeout=30.0)
+    _tiny_mnist(master)
+    master.initialize()
+    slaves = []
+    dashboard = None
+    try:
+        port = master._server.address[1]
+        for _ in range(2):
+            prng.get().seed(42)
+            prng.get("loader").seed(43)
+            slave = Launcher(master_address="127.0.0.1:%d" % port,
+                             graphics=False, heartbeat_interval=0.1)
+            _tiny_mnist(slave)
+            slave.initialize()
+            slaves.append(slave)
+        sids = sorted(s._client.id for s in slaves)
+        dashboard = WebStatusServer(host="127.0.0.1", port=0).start()
+        base = "http://127.0.0.1:%d" % dashboard.port
+
+        def cluster():
+            with urllib.request.urlopen(base + "/cluster.json",
+                                        timeout=5) as resp:
+                return json.loads(resp.read())
+
+        deadline = time.time() + 20
+        while True:
+            report = cluster()
+            if sorted(report["slaves"]) == sids and all(
+                    entry["state"] == "healthy" and entry["telemetry"]
+                    for entry in report["slaves"].values()):
+                break
+            assert time.time() < deadline, report
+            time.sleep(0.1)
+        assert report["run"]["trace_id"] == master._server.trace_id
+
+        # pause one slave's heartbeats: the scorer's silence component
+        # must flag it while the healthy peer keeps beating
+        victim = slaves[1]._client
+        victim_sid = victim.id
+        t_pause = time.time()
+        victim._hb_stop.set()
+        victim._hb_wake.set()
+        deadline = time.time() + 10
+        while cluster()["slaves"][victim_sid]["state"] != "straggler":
+            assert time.time() < deadline, cluster()
+            time.sleep(0.05)
+        detect_s = time.time() - t_pause
+        assert detect_s < 5.0, detect_s
+
+        # ...and the SLO engine raises the alert gauge (the reap loop
+        # sweeps it once a second)
+        deadline = time.time() + 10
+        gauge = get_registry().get("veles_alerts_active")
+        while True:
+            active = {labels["rule"]: child.value
+                      for labels, child in gauge.series()}
+            if active.get("slave_straggler") == 1.0:
+                break
+            assert time.time() < deadline, active
+            time.sleep(0.1)
+        with urllib.request.urlopen(base + "/alerts.json",
+                                    timeout=5) as resp:
+            alerts_report = json.loads(resp.read())
+        firing = [r["name"] for r in alerts_report["rules"]
+                  if r["firing"]]
+        assert "slave_straggler" in firing
+    finally:
+        if dashboard is not None:
+            dashboard.stop()
+        for slave in slaves:
+            slave.stop()
+        master.stop()

@@ -340,6 +340,11 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         m_decode_ms = registry.histogram(
             "veles_exchange_decode_ms",
             "Master time decoding one slave update", labels=("slave",))
+        # encode/decode times also feed the straggler scorer — a slave
+        # whose exchanges run far over the peer median is the early
+        # sign of a saturated link or a swapping host
+        from veles_tpu.telemetry import health as health_mod
+        scorer = health_mod.get_scorer()
 
         def job_source(slave):
             try:
@@ -376,8 +381,11 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             else:
                 # remote slaves get zlib-compressed binary frames
                 blob = _encode(data, compress=True)
-            m_encode_ms.labels(slave=slave.id).observe(
-                (time.perf_counter() - t0) * 1e3)
+            encode_ms = (time.perf_counter() - t0) * 1e3
+            m_encode_ms.labels(slave=slave.id).observe(encode_ms)
+            # create=False: this runs outside the coordinator lock —
+            # it must not resurrect a slave the reaper just removed
+            scorer.observe(slave.id, encode_ms=encode_ms, create=False)
             m_bytes.labels(slave=slave.id, direction="to_slave").inc(
                 _blob_nbytes(blob))
             return {"blob": blob}
@@ -385,8 +393,9 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         def result_sink(data, slave):
             t0 = time.perf_counter()
             payload = _decode(data["blob"])
-            m_decode_ms.labels(slave=slave.id).observe(
-                (time.perf_counter() - t0) * 1e3)
+            decode_ms = (time.perf_counter() - t0) * 1e3
+            m_decode_ms.labels(slave=slave.id).observe(decode_ms)
+            scorer.observe(slave.id, decode_ms=decode_ms, create=False)
             m_bytes.labels(slave=slave.id, direction="from_slave").inc(
                 _blob_nbytes(data["blob"]))
             workflow.apply_data_from_slave(payload, slave)
@@ -397,6 +406,26 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         def initial_data_source(slave):
             return _encode(workflow.generate_initial_data_for_slave(slave),
                            compress=not slave.sharedio)
+
+        def on_slave_flight(sid, notice):
+            # a slave's flight recorder tripped: dump ONE cluster
+            # record on the master — its own ring + the per-slave
+            # health table + the run's shared trace id — so a NaN on
+            # one slave yields one correlated artifact, not N files
+            # (the recorder's per-reason rate limit collapses a
+            # same-sweep storm from many slaves into one dump)
+            from veles_tpu.telemetry import federation as fed_mod
+            from veles_tpu.telemetry import flight as flight_mod
+            reason = str(notice.get("reason") or "unknown")
+            self.warning("slave %s flight record (%s): %s", sid,
+                         reason, notice.get("path"))
+            flight_mod.get_recorder().dump(
+                "cluster_" + reason, slave=sid,
+                slave_record=notice.get("path"),
+                slave_context=notice.get("context"),
+                trace_id=notice.get("trace_id") or
+                fed_mod.get_federation().run_info.get("trace_id"),
+                cluster=fed_mod.cluster_report())
 
         bind = parse_address(self.listen_address)
         if self.secret is None and bind[0] not in (
@@ -412,7 +441,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             heartbeat_timeout=self.heartbeat_timeout,
             job_source=job_source, result_sink=result_sink,
             on_drop=on_drop, initial_data_source=initial_data_source,
-            secret=self.secret, max_frame=self.max_frame)
+            secret=self.secret, max_frame=self.max_frame,
+            on_slave_flight=on_slave_flight)
         # every span this master records carries the run's trace id;
         # slaves adopt the same id from the handshake reply
         tracing.set_default_trace_id(self._server.trace_id)
@@ -497,6 +527,18 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             # step spans merge with the master's on one timeline
             tracing.set_default_trace_id(self._client.trace_id)
         self.info("connected to master as slave %s", self._client.id)
+        # when THIS slave's black box trips (NaN, stall, crash), tell
+        # the master on the next (woken) heartbeat so it can dump the
+        # correlated cluster record
+        from veles_tpu.telemetry import flight as flight_mod
+        client = self._client
+
+        def notify(reason, path, context):
+            if not reason.startswith("cluster_"):
+                client.notify_flight(reason, path, context)
+
+        self._flight_listener = notify
+        flight_mod.get_recorder().add_dump_listener(notify)
         if self._client.initial_data is not None:
             # the MASTER's negotiates_on_connect state, from the handshake
             self.workflow.apply_initial_data_from_master(
@@ -580,6 +622,15 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                 perf["flight_record"] = record
         except Exception:
             pass
+        cluster = None
+        if self._server is not None:
+            # the per-slave health table rides the status POST so a
+            # dashboard in ANOTHER process can serve /cluster.json too
+            try:
+                from veles_tpu.telemetry import federation
+                cluster = federation.cluster_report()
+            except Exception:
+                cluster = None
         return {
             "id": self.id, "log_id": self.log_id, "mode": self.mode,
             "name": wf.name if wf else None,
@@ -589,6 +640,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "units": len(wf) if wf else 0,
             "stopped": self.stopped,
             "perf": perf,
+            "cluster": cluster,
             "graph": getattr(self, "_graph_cache", None),
         }
 
@@ -665,6 +717,11 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             return
         self.stopped = True
         self._finished.set()
+        listener = getattr(self, "_flight_listener", None)
+        if listener is not None:
+            from veles_tpu.telemetry import flight as flight_mod
+            flight_mod.get_recorder().remove_dump_listener(listener)
+            self._flight_listener = None
         if self._client is not None:
             self._client.close()
         if self._node_launcher is not None:

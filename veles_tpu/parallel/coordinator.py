@@ -21,6 +21,7 @@ one reader thread per connection on the master, a single client thread
 on the slave. Job payloads must be JSON-serializable.
 """
 
+import collections
 import hmac
 import json
 import os
@@ -33,7 +34,7 @@ import uuid
 from veles_tpu import prng
 from veles_tpu.logger import Logger
 from veles_tpu.parallel import wire
-from veles_tpu.telemetry import tracing
+from veles_tpu.telemetry import federation, health, tracing
 from veles_tpu.telemetry.registry import get_registry
 
 
@@ -458,7 +459,8 @@ class CoordinatorServer(Logger):
     def __init__(self, address=("127.0.0.1", 0), checksum="",
                  job_timeout=None, heartbeat_timeout=10.0,
                  job_source=None, result_sink=None, on_drop=None,
-                 initial_data_source=None, secret=None, max_frame=None):
+                 initial_data_source=None, secret=None, max_frame=None,
+                 on_slave_flight=None):
         super(CoordinatorServer, self).__init__()
         self.checksum = checksum
         self.max_frame = max_frame
@@ -505,6 +507,21 @@ class CoordinatorServer(Logger):
             labels=("slave",))
         self._m_drops = registry.counter(
             "veles_slave_drops_total", "Slaves dropped (death/timeout)")
+        self._m_hb_handler_ms = registry.histogram(
+            "veles_heartbeat_handler_ms",
+            "Master time absorbing one heartbeat's telemetry piggyback")
+        self._m_flight_notices = registry.counter(
+            "veles_cluster_flight_notices_total",
+            "Flight-record notices received from slaves",
+            labels=("slave",))
+        #: the cluster observability plane (ISSUE 9): slave snapshot
+        #: deltas merge here, the scorer rates slaves against peers,
+        #: and on_slave_flight(sid, notice) fires when a slave's
+        #: flight recorder trips (the launcher dumps a cluster record)
+        self.federation = federation.get_federation()
+        self.federation.set_run_info(trace_id=self.trace_id)
+        self.health = health.get_scorer()
+        self.on_slave_flight = on_slave_flight
         self.slaves = {}
         self.jobs = []                 # pending job payloads
         self.results = []
@@ -531,6 +548,16 @@ class CoordinatorServer(Logger):
         while not self._done.wait(min(self.heartbeat_timeout / 4, 1.0)):
             with self._lock:
                 self._reap_dead()
+            # periodic cluster scoring even when no heartbeat arrives
+            # (a fully-silent fleet must still be re-scored), and the
+            # SLO sweep — both internally throttled and lock-free
+            # w.r.t. self._lock
+            self.health.evaluate()
+            try:
+                from veles_tpu.telemetry import alerts
+                alerts.get_engine().evaluate()
+            except Exception:
+                self.warning("alert sweep failed", exc_info=True)
 
     # -- job management ----------------------------------------------------
 
@@ -585,11 +612,34 @@ class CoordinatorServer(Logger):
                 # handler also calls drop_slave on a clean end-of-run
                 # disconnect, which is not a death/timeout
                 self._m_drops.inc()
+                # a DEAD slave's labeled series go too (clean
+                # disconnects keep theirs — end-of-run snapshots still
+                # want them): a churny run replacing slaves for hours
+                # must not grow {slave=...} cardinality without bound
+                for family in (self._m_rtt_ms, self._m_job_ms,
+                               self._m_source_ms, self._m_sink_ms,
+                               self._m_jobs, self._m_flight_notices):
+                    family.remove(slave=sid)
+                # the launcher-owned exchange families are slave-
+                # labeled too; reach them by name (a static-farming
+                # server without a launcher simply has none)
+                registry = get_registry()
+                for name in ("veles_exchange_bytes_total",
+                             "veles_exchange_encode_ms",
+                             "veles_exchange_decode_ms"):
+                    family = registry.get(name)
+                    if family is not None and \
+                            "slave" in family.label_names:
+                        family.remove(slave=sid)
                 self.drop_slave(sid)
 
     def drop_slave(self, sid):
         slave = self.slaves.pop(sid, None)
         if slave is not None:
+            # the federated feed and health row describe a LIVE slave:
+            # GC them on every drop, clean or not
+            self.federation.remove_slave(sid)
+            self.health.remove(sid)
             if slave.jobs_in_flight:
                 if self.on_drop is None:
                     # static job farming: requeue the raw payloads
@@ -756,6 +806,7 @@ class CoordinatorServer(Logger):
                     self.job_times.append(job_elapsed)
                     self._m_job_ms.labels(slave=sid).observe(
                         job_elapsed * 1e3)
+                    self.health.observe(sid, job_ms=job_elapsed * 1e3)
                     if slave.jobs_in_flight:
                         # the prefetched job only STARTS computing now:
                         # restart its clock so the adaptive timeout and
@@ -776,9 +827,14 @@ class CoordinatorServer(Logger):
             elif cmd == "heartbeat":
                 slave.power = msg.get("power", slave.power)
                 self._record_rtt(sid, msg)
-                return {"ok": True}, False
+                action = "heartbeat"
             else:
                 return {"error": "unknown cmd %r" % cmd}, False
+
+        if action == "heartbeat":
+            reply = {"ok": True}
+            reply.update(self._absorb_telemetry(sid, msg))
+            return reply, False
 
         if action == "source":
             payload = None
@@ -787,10 +843,14 @@ class CoordinatorServer(Logger):
                 payload = self.job_source(slave)
             except NoMoreJobsError:
                 self.no_more_jobs = True
-            if payload is not None:
-                self._m_source_ms.labels(slave=sid).observe(
-                    (time.perf_counter() - t0) * 1e3)
+            source_ms = (time.perf_counter() - t0) * 1e3
             with self._lock:
+                if payload is not None and sid in self.slaves:
+                    # recorded under the liveness check: job_source
+                    # ran outside _lock, and observing after a reap
+                    # would re-mint the just-GC'd labeled child
+                    self._m_source_ms.labels(slave=sid).observe(
+                        source_ms)
                 if sid not in self.slaves:
                     # the reaper dropped this slave while the job was
                     # being generated: the workflow registered the
@@ -837,6 +897,57 @@ class CoordinatorServer(Logger):
         rtt = msg.get("rtt_ms")
         if isinstance(rtt, (int, float)):
             self._m_rtt_ms.labels(slave=sid).observe(float(rtt))
+            self.health.observe(sid, rtt_ms=float(rtt), beat=True)
+        else:
+            self.health.observe(sid, beat=True)
+
+    def _absorb_telemetry(self, sid, msg):
+        """The master half of the heartbeat piggyback (runs OUTSIDE
+        ``_lock``): merge the registry delta, surface flight notices,
+        re-score the fleet. Returns ack hints for the reply (e.g.
+        ``{"resync": True}``)."""
+        t0 = time.perf_counter()
+        hints = {}
+        delta = msg.get("telemetry")
+        if isinstance(delta, dict):
+            try:
+                hints = self.federation.apply(sid, delta) or {}
+            except Exception:
+                self.warning("federation merge failed for slave %s",
+                             sid, exc_info=True)
+        if isinstance(delta, dict):
+            # re-check liveness AFTER the merge: this runs outside
+            # _lock, so the reaper (or a clean disconnect) may have
+            # dropped the slave between the handler's liveness check
+            # and apply() — which would re-create the just-GC'd feed
+            # as a permanent phantom
+            with self._lock:
+                alive = sid in self.slaves
+            if not alive:
+                self.federation.remove_slave(sid)
+                self.health.remove(sid)
+                hints = {}
+        notices = msg.get("flight")
+        if isinstance(notices, list):
+            for notice in notices[:8]:
+                if not isinstance(notice, dict):
+                    continue
+                reason = str(notice.get("reason") or "")
+                if reason.startswith("cluster_"):
+                    # never re-federate a cluster record (an in-process
+                    # master+slave test shares ONE recorder — this is
+                    # the recursion guard)
+                    continue
+                self._m_flight_notices.labels(slave=sid).inc()
+                if self.on_slave_flight is not None:
+                    try:
+                        self.on_slave_flight(sid, notice)
+                    except Exception:
+                        self.warning("on_slave_flight failed for %s",
+                                     sid, exc_info=True)
+        self.health.evaluate()
+        self._m_hb_handler_ms.observe((time.perf_counter() - t0) * 1e3)
+        return hints
 
     def snapshot_slaves(self):
         """Consistent copy of the slave registry for outside readers."""
@@ -856,6 +967,11 @@ class CoordinatorServer(Logger):
                     slave.power = msg.get("power", slave.power)
                     self._record_rtt(sid, msg)
                     reply, stop = {"ok": True}, False
+            if not stop:
+                # federation merge / flight fan-out / health scoring
+                # run OUTSIDE the registry lock so a big delta can
+                # never starve the job path or the reaper
+                reply.update(self._absorb_telemetry(sid, msg))
             proto.send(reply)
             if stop:
                 return
@@ -875,7 +991,7 @@ class CoordinatorClient(Logger):
     def __init__(self, address, checksum="", power=1.0,
                  death_probability=0.0, rand="chaos",
                  heartbeat_interval=2.0, pipeline=True, secret=None,
-                 max_frame=None):
+                 max_frame=None, federate=None):
         super(CoordinatorClient, self).__init__()
         self.address = tuple(address)
         self.checksum = checksum
@@ -884,6 +1000,17 @@ class CoordinatorClient(Logger):
         self.power = power
         self.death_probability = death_probability
         self.heartbeat_interval = heartbeat_interval
+        #: piggyback delta-encoded registry snapshots on heartbeats so
+        #: the master can serve ONE federated /metrics for the cluster
+        #: (VELES_FEDERATION=0 turns the piggyback off fleet-wide)
+        if federate is None:
+            federate = os.environ.get("VELES_FEDERATION", "1") != "0"
+        self.federate = federate
+        self._snapshot_encoder = None
+        #: flight-record notices queued for the next beat (bounded: an
+        #: incident storm must not balloon the heartbeat message)
+        self._flight_notices = collections.deque(maxlen=16)
+        self._hb_wake = threading.Event()
         #: prefetch the next job while the current one computes.
         #: Overlap costs one job of weight staleness (async SGD — the
         #: reference's balance-2 protocol had the same property);
@@ -957,25 +1084,71 @@ class CoordinatorClient(Logger):
         self._hb_proto.send({"cmd": "hb_attach", "id": self.id,
                              "nonce": hb_nonce})
         self._answer_auth(self._hb_proto, self._hb_proto.recv(), hb_nonce)
+        if self.federate:
+            from veles_tpu.telemetry.federation import SnapshotEncoder
+            self._snapshot_encoder = SnapshotEncoder()
         t = threading.Thread(target=self._hb_loop, daemon=True,
                              name="slave-heartbeat-%s" % self.id)
         t.start()
         return self
 
+    def notify_flight(self, reason, path=None, context=None):
+        """Queue a flight-record notice for the next heartbeat and
+        wake the beat loop so the master learns promptly (the
+        FlightRecorder dump-listener hook calls this)."""
+        notice = {"reason": str(reason), "path": path,
+                  "t": time.time(), "trace_id": self.trace_id}
+        if isinstance(context, dict):
+            # the notice rides a JSON control line: stringify anything
+            # a detector stuffed in that json.dumps would choke on
+            notice["context"] = {
+                str(k): v if isinstance(v, (int, float, str, bool,
+                                            type(None))) else str(v)
+                for k, v in context.items()}
+        self._flight_notices.append(notice)
+        self._hb_wake.set()
+
     def _hb_loop(self):
         # each beat reports the round-trip the PREVIOUS beat measured;
-        # the master aggregates them per slave (heartbeat RTT series)
+        # the master aggregates them per slave (heartbeat RTT series).
+        # Since ISSUE 9 a beat also carries the registry snapshot
+        # delta and any queued flight notices (notify_flight wakes the
+        # loop early so incident news never waits a full interval).
         rtt_ms = None
-        while not self._hb_stop.wait(self.heartbeat_interval):
+        while True:
+            self._hb_wake.wait(self.heartbeat_interval)
+            self._hb_wake.clear()
+            if self._hb_stop.is_set():
+                return
+            msg = {"cmd": "heartbeat", "power": self.power,
+                   "rtt_ms": rtt_ms}
+            if self._snapshot_encoder is not None:
+                try:  # telemetry must never kill the beat
+                    delta = self._snapshot_encoder.encode()
+                except Exception:
+                    delta = None
+                if delta is not None:
+                    msg["telemetry"] = delta
+            notices = []
+            while self._flight_notices:
+                try:
+                    notices.append(self._flight_notices.popleft())
+                except IndexError:
+                    break
+            if notices:
+                msg["flight"] = notices
             try:
                 t0 = time.perf_counter()
-                self._hb_proto.send({"cmd": "heartbeat",
-                                     "power": self.power,
-                                     "rtt_ms": rtt_ms})
-                self._hb_proto.recv()
+                self._hb_proto.send(msg)
+                reply = self._hb_proto.recv()
                 rtt_ms = (time.perf_counter() - t0) * 1e3
             except (ConnectionError, OSError):
                 return
+            if isinstance(reply, dict) and reply.get("resync") and \
+                    self._snapshot_encoder is not None:
+                # the master saw a sequence gap: its view may hold
+                # stale series — push everything next beat
+                self._snapshot_encoder.mark_resync()
 
     def serve_forever(self, handler, idle_sleep=0.05, max_idle=None,
                       pipeline=None):
@@ -1066,6 +1239,7 @@ class CoordinatorClient(Logger):
 
     def close(self):
         self._hb_stop.set()
+        self._hb_wake.set()  # unblock a beat loop mid-wait
         self.proto.close()
         if hasattr(self, "_hb_proto"):
             self._hb_proto.close()

@@ -108,6 +108,10 @@ class ServingFrontend(Logger):
         self.address = self._server.server_address
         self._thread = None
         self._reporter = None
+        # continuous SLO evaluation (p95 / queue-depth / shed-burn
+        # rules) — the series item 3's autoscaler will consume
+        from veles_tpu.telemetry import alerts
+        alerts.get_engine().start()
 
     @property
     def port(self):
@@ -192,6 +196,9 @@ class ServingFrontend(Logger):
         if handler.path.startswith("/profile.json"):
             from veles_tpu.telemetry import profiler
             self._respond(handler, 200, profiler.profile_report())
+        elif handler.path.startswith("/alerts.json"):
+            from veles_tpu.telemetry import alerts
+            self._respond(handler, 200, alerts.get_engine().report())
         elif handler.path.startswith("/metrics.json"):
             self._respond(handler, 200, self.metrics.snapshot())
         elif handler.path.startswith("/metrics"):

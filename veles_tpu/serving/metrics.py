@@ -103,6 +103,9 @@ class ServingMetrics(object):
             "veles_serving_batch_occupancy",
             "Real rows / compiled bucket size per batch",
             reservoir_size=reservoir_size)
+        self._m_queue_depth = registry.gauge(
+            "veles_serving_queue_depth",
+            "Live admission-queue depth (refreshed on snapshot)")
 
     # -- wiring ------------------------------------------------------------
 
@@ -181,6 +184,10 @@ class ServingMetrics(object):
         # callables outside the lock: they take their own locks
         out["queue_depth"] = (self._queue_depth_fn()
                               if self._queue_depth_fn is not None else 0)
+        # mirror into the registry so alert rules (serving_queue_deep)
+        # and the federated cluster view can see the depth — refreshed
+        # by every snapshot (the status reporter ticks it every ~2 s)
+        self._m_queue_depth.set(out["queue_depth"])
         if self._replica_stats_fn is not None:
             out["replicas"] = self._replica_stats_fn()
         return out

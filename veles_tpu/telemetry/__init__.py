@@ -11,6 +11,8 @@ shared by training, the distributed coordinator and the serving engine
 """
 
 from veles_tpu.telemetry import registry, tracing  # noqa: F401
+# alerts/federation/health (the cluster observability plane) are
+# imported lazily by their consumers to keep bare imports cheap
 from veles_tpu.telemetry.registry import (Counter, Gauge, Histogram,  # noqa: F401,E501
                                           MetricsRegistry, Reservoir,
                                           get_registry, percentile)

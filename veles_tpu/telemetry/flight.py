@@ -98,6 +98,7 @@ class FlightRecorder(object):
         self._last_dump = {}                # reason -> perf_counter
         self._last_path = None
         self._min_dump_interval_s = min_dump_interval_s
+        self._dump_listeners = []
         registry = get_registry()
         self._m_records = registry.counter(
             "veles_flight_records_total",
@@ -233,6 +234,21 @@ class FlightRecorder(object):
                       stall_factor=self.stall_factor,
                       dump_stacks=True)
 
+    # -- dump listeners ----------------------------------------------------
+
+    def add_dump_listener(self, fn):
+        """``fn(reason, path, context)`` runs after every successful
+        dump — the hook a distributed slave uses to notify its master
+        so ONE correlated cluster record replaces N disjoint files."""
+        with self._lock:
+            self._dump_listeners.append(fn)
+        return fn
+
+    def remove_dump_listener(self, fn):
+        with self._lock:
+            if fn in self._dump_listeners:
+                self._dump_listeners.remove(fn)
+
     # -- dumping -----------------------------------------------------------
 
     def record_exception(self, exc, step=None):
@@ -300,9 +316,16 @@ class FlightRecorder(object):
             return None
         with self._lock:
             self._last_path = path
+            listeners = list(self._dump_listeners)
         self._m_records.labels(reason=reason).inc()
         logging.getLogger("flight").error(
             "flight record (%s) written to %s", reason, path)
+        for fn in listeners:
+            try:  # a broken notifier must not mask the record itself
+                fn(reason, path, dict(context))
+            except Exception:
+                logging.getLogger("flight").warning(
+                    "flight dump listener failed", exc_info=True)
         return path
 
     def last_record_path(self):

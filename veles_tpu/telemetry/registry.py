@@ -105,6 +105,29 @@ class _Metric(object):
         with self._lock:
             self._children.clear()
 
+    def remove(self, **labels):
+        """Drop children matching ``labels``; a SUBSET of the label
+        names removes every child whose values match on those names
+        (``family.remove(slave=sid)`` clears all of a dead slave's
+        series regardless of its other labels). Returns the number of
+        children removed — label cardinality stays bounded only if
+        somebody actually calls this when the labeled entity dies."""
+        unknown = set(labels) - set(self.label_names)
+        if unknown:
+            raise ValueError("unknown labels %s (family %s has %s)"
+                             % (sorted(unknown), self.name,
+                                self.label_names))
+        match = {name: str(value) for name, value in labels.items()}
+        removed = 0
+        with self._lock:
+            for key in list(self._children):
+                values = dict(zip(self.label_names, key))
+                if all(values[name] == want
+                       for name, want in match.items()):
+                    del self._children[key]
+                    removed += 1
+        return removed
+
     def series(self):
         """[(labels_dict, child)] — a consistent copy."""
         with self._lock:
@@ -313,41 +336,46 @@ class MetricsRegistry(object):
     def render_prometheus(self):
         """Prometheus text exposition (0.0.4): counters and gauges as
         themselves, histograms as summaries with ``quantile`` labels.
-        Held under the registry lock end to end — see snapshot()."""
-        lines = []
-        with self._lock:
-            metrics = sorted(self._metrics.values(),
-                             key=lambda m: m.name)
-            self._render_locked(metrics, lines)
-        return "\n".join(lines) + "\n"
+        The snapshot is taken under the registry lock (consistent
+        triples); rendering works on the copy."""
+        return render_snapshot(self.snapshot())
 
-    def _render_locked(self, metrics, lines):
-        for metric in metrics:
-            ptype = ("summary" if metric.kind == "histogram"
-                     else metric.kind)
-            if metric.help:
-                lines.append("# HELP %s %s"
-                             % (metric.name,
-                                metric.help.replace("\n", " ")))
-            lines.append("# TYPE %s %s" % (metric.name, ptype))
-            for labels, child in metric.series():
-                if metric.kind == "histogram":
-                    values = child.reservoir.sorted_values()
-                    for q in (0.5, 0.95, 0.99):
-                        lines.append("%s%s %s" % (
-                            metric.name,
-                            _fmt_labels(labels,
-                                        [("quantile", "%g" % q)]),
-                            repr(percentile(values, q * 100))))
-                    lines.append("%s_count%s %d" % (
-                        metric.name, _fmt_labels(labels), child.count))
-                    lines.append("%s_sum%s %s" % (
-                        metric.name, _fmt_labels(labels),
-                        repr(child.sum)))
-                else:
+
+def render_snapshot(snap):
+    """Prometheus text exposition of a :meth:`MetricsRegistry.
+    snapshot` dict — THE renderer, shared with the federation's
+    merged cluster view (which folds slave series into a snapshot
+    before rendering)."""
+    families = []
+    for kind, ptype in (("counters", "counter"), ("gauges", "gauge"),
+                        ("histograms", "summary")):
+        for name, family in snap.get(kind, {}).items():
+            families.append((name, ptype, family))
+    lines = []
+    for name, ptype, family in sorted(families):
+        if family.get("help"):
+            lines.append("# HELP %s %s"
+                         % (name, family["help"].replace("\n", " ")))
+        lines.append("# TYPE %s %s" % (name, ptype))
+        for entry in family.get("series", ()):
+            labels = entry.get("labels") or {}
+            if ptype == "summary":
+                for q, key in ((0.5, "p50"), (0.95, "p95"),
+                               (0.99, "p99")):
                     lines.append("%s%s %s" % (
-                        metric.name, _fmt_labels(labels),
-                        repr(child.value)))
+                        name,
+                        _fmt_labels(labels, [("quantile", "%g" % q)]),
+                        repr(float(entry.get(key, 0.0)))))
+                lines.append("%s_count%s %d"
+                             % (name, _fmt_labels(labels),
+                                int(entry.get("count", 0))))
+                lines.append("%s_sum%s %s"
+                             % (name, _fmt_labels(labels),
+                                repr(float(entry.get("sum", 0.0)))))
+            else:
+                lines.append("%s%s %s" % (name, _fmt_labels(labels),
+                                          repr(float(entry["value"]))))
+    return "\n".join(lines) + "\n"
 
 
 #: THE process-wide registry.

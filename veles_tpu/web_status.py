@@ -27,7 +27,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
-from veles_tpu.telemetry import profiler
+from veles_tpu.telemetry import alerts, federation, profiler
 from veles_tpu.telemetry.registry import get_registry
 
 GARBAGE_TIMEOUT = 60
@@ -47,7 +47,9 @@ th { background: #eee; }
 <a href="/logs.html">logs</a> ·
 <a href="/frontend.html">command composer</a> ·
 <a href="/metrics">metrics</a> ·
-<a href="/profile.json">profile</a></p>
+<a href="/profile.json">profile</a> ·
+<a href="/cluster.json">cluster</a> ·
+<a href="/alerts.json">alerts</a></p>
 <div id="perf" style="margin-bottom:1em"></div>
 <table id="wf"><thead><tr>
 <th>id</th><th>name</th><th>mode</th><th>master</th><th>uptime</th>
@@ -573,10 +575,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(_STATUS_PAGE, ctype="text/html; charset=utf-8")
         elif self.path.startswith("/profile.json"):
             self._reply(profiler.profile_report())
+        elif self.path.startswith("/cluster.json"):
+            self._reply(self.server.owner.cluster_report())
+        elif self.path.startswith("/alerts.json"):
+            self._reply(alerts.get_engine().report())
         elif self.path.startswith("/metrics.json"):
-            self._reply(get_registry().snapshot())
+            # cluster-wide: local registry + federated slave series
+            self._reply(federation.cluster_snapshot())
         elif self.path.startswith("/metrics"):
-            self._reply(get_registry().render_prometheus(),
+            self._reply(federation.render_cluster_prometheus(),
                         ctype="text/plain; version=0.0.4")
         elif self.path.startswith("/logs.html"):
             self._reply(_LOGS_PAGE, ctype="text/html; charset=utf-8")
@@ -656,6 +663,10 @@ class WebStatusServer(Logger):
         self._m_records = registry.counter(
             "veles_webstatus_records_total",
             "Log/event records received", labels=("kind",))
+        # the SLO engine evaluates continuously while a dashboard is
+        # up, so /alerts.json and veles_alerts_active are live even in
+        # a process that has no coordinator ticking them
+        alerts.get_engine().start()
 
     #: the routes the handler actually serves — anything else counts as
     #: "other": a port scanner probing random paths must not mint an
@@ -663,8 +674,8 @@ class WebStatusServer(Logger):
     KNOWN_PATHS = frozenset([
         "/", "/status.html", "/logs.html", "/slaves.html",
         "/frontend.html", "/workflow.html", "/timeline.html", "/catalog",
-        "/metrics", "/metrics.json", "/profile.json", "/update",
-        "/service", "/logs", "/events"])
+        "/metrics", "/metrics.json", "/profile.json", "/cluster.json",
+        "/alerts.json", "/update", "/service", "/logs", "/events"])
 
     def count_request(self, path):
         path = path.split("?")[0] or "/"
@@ -689,6 +700,19 @@ class WebStatusServer(Logger):
             return self._catalog
 
     # -- receiving ---------------------------------------------------------
+
+    def cluster_report(self):
+        """The ``/cluster.json`` body: this process's federated view
+        (live when the dashboard is embedded in the master) plus the
+        health tables remote masters POSTed with their status."""
+        report = federation.cluster_report()
+        with self._lock:
+            masters = {mid: master.get("cluster")
+                       for mid, master in self.masters.items()
+                       if master.get("cluster")}
+        if masters:
+            report["masters"] = masters
+        return report
 
     def receive_update(self, data):
         """A master's periodic status (``web_status.py:244-251``)."""
