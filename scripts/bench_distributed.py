@@ -142,38 +142,76 @@ def run_standalone():
         "samples_per_sec": round(rate, 1)}))
 
 
-def run_master(n_slaves):
+def run_master(n_slaves, port=0):
     from veles_tpu.launcher import Launcher
-    launcher = Launcher(listen_address="127.0.0.1:0", graphics=False,
-                        segment_size=SEGMENT)
-    wf = _build(launcher)
+    chaos = os.environ.get("VELES_DIST_CHAOS")
+    launcher = Launcher(
+        listen_address="127.0.0.1:%d" % port, graphics=False,
+        segment_size=SEGMENT,
+        heartbeat_timeout=float(os.environ.get("VELES_DIST_HBT", 10.0)))
+    _build(launcher)
     launcher.initialize()
+    # auto-resume (VELES_AUTO_RESUME) may have replaced the built
+    # workflow with the restored one — the launcher's is authoritative
+    wf = launcher.workflow
     print("PORT=%d" % launcher._server.address[1], file=sys.stderr,
           flush=True)
+    if launcher._resumed_from:
+        print("EVENT resumed t=%.6f n=%d" %
+              (time.time(), len(wf.decision.epoch_history)),
+              file=sys.stderr, flush=True)
     deadline = time.time() + 900
     while len(launcher._server.snapshot_slaves()) < n_slaves:
         if time.time() > deadline:
             raise RuntimeError("slaves did not connect within 900s")
         time.sleep(0.2)
-    if os.environ.get("VELES_DIST_CHAOS"):
-        _watch_stragglers(launcher)
+    if chaos:
+        _start_chaos_watchers(launcher, chaos)
     elapsed, stamps = _timed_run(launcher, wf)
-    rate = _steady_rate(stamps, _samples_per_epoch())
+    epochs = len(wf.decision.epoch_history)
     print("master[%s, %d slaves]: %d epochs in %.1fs, stamps %s"
-          % (CONFIG, n_slaves, len(stamps), elapsed,
+          % (CONFIG, n_slaves, epochs, elapsed,
              " ".join("%.1f" % s for s in stamps)), file=sys.stderr)
-    print(json.dumps({
-        "leg": "distributed_%d_slave" % n_slaves, "config": CONFIG,
-        "elapsed_s": round(elapsed, 2), "epochs": len(stamps),
-        "samples_per_sec": round(rate, 1)}))
+    out = {"leg": "distributed_%d_slave" % n_slaves, "config": CONFIG,
+           "elapsed_s": round(elapsed, 2), "epochs": epochs}
+    if not chaos:
+        # bench legs NEED the steady rate (the orchestrators index
+        # it); _steady_rate raises its clear >=3-epochs error here
+        # instead of a downstream KeyError
+        out["samples_per_sec"] = round(
+            _steady_rate(stamps, _samples_per_epoch()), 1)
+    elif len(stamps) >= 3:
+        out["samples_per_sec"] = round(
+            _steady_rate(stamps, _samples_per_epoch()), 1)
+    print(json.dumps(out))
 
 
-def _watch_stragglers(launcher):
-    """Chaos leg: announce the first straggler transition on stderr
-    (timestamped with the shared wall clock, so the parent can compute
-    time-to-detection against the moment it paused the slave)."""
+def _counter_total(name):
+    from veles_tpu.telemetry.registry import get_registry
+    family = get_registry().get(name)
+    if family is None:
+        return 0.0
+    return sum(child.value for _, child in family.series())
 
-    def watch():
+
+def _hist_count(name, **labels):
+    from veles_tpu.telemetry.registry import get_registry
+    family = get_registry().get(name)
+    if family is None:
+        return 0
+    total = 0
+    for series_labels, child in family.series():
+        if all(series_labels.get(k) == v for k, v in labels.items()):
+            total += child.count
+    return total
+
+
+def _start_chaos_watchers(launcher, kind):
+    """Announce chaos-relevant transitions on stderr, timestamped with
+    the shared wall clock so the parent can compute time-to-X against
+    the moment it injected the fault."""
+
+    def watch_straggler():
         scorer = launcher._server.health
         while True:
             for sid, row in scorer.table().items():
@@ -184,9 +222,82 @@ def _watch_stragglers(launcher):
                     return
             time.sleep(0.05)
 
+    def watch_kill():
+        # a SIGKILL'd slave's sockets close from the kernel: the drop
+        # surfaces on the drops counter (the _serve finally classifies
+        # a no-goodbye mid-run disconnect as a death even if the kill
+        # landed on an idle instant), recovery as the first resolved
+        # result after the requeue (veles_recovery_ms{event=requeue})
+        drops_base = _counter_total("veles_slave_drops_total")
+        requeue_base = _counter_total("veles_jobs_requeued_total")
+        drop_seen = None
+        while True:
+            now = time.time()
+            if drop_seen is None and \
+                    _counter_total("veles_slave_drops_total") > drops_base:
+                print("EVENT drop t=%.6f" % now,
+                      file=sys.stderr, flush=True)
+                drop_seen = now
+            if drop_seen is not None and (
+                    _hist_count("veles_recovery_ms", event="requeue") > 0
+                    or (_counter_total("veles_jobs_requeued_total") ==
+                        requeue_base and now - drop_seen > 0.5)):
+                # still-zero requeues a beat AFTER the drop (the drop
+                # counter increments before the requeue accounting, so
+                # a same-poll read could race it) = the victim held
+                # nothing: recovery is trivially immediate
+                print("EVENT recovered t=%.6f" % now,
+                      file=sys.stderr, flush=True)
+                return
+            time.sleep(0.02)
+
+    def watch_epochs():
+        seen = 0
+        while True:
+            n = len(launcher.workflow.decision.epoch_history)
+            while seen < n:
+                seen += 1
+                print("EVENT epoch n=%d t=%.6f" % (seen, time.time()),
+                      file=sys.stderr, flush=True)
+            time.sleep(0.05)
+
+    def watch_state():
+        # periodic one-line scheduler state: when a chaos leg wedges,
+        # THIS is the line that says which side is withholding
+        while True:
+            try:
+                wf = launcher.workflow
+                loader, decision = wf.loader, wf.decision
+                slaves = launcher._server.snapshot_slaves()
+                print("EVENT state t=%.6f ep=%s off=%s open=%s "
+                      "buckets=%s failed=%d pending=%s inflight=%s "
+                      "hist=%d hasdata=%s nomore=%s" %
+                      (time.time(), loader.epoch_number,
+                       loader._global_offset,
+                       getattr(decision, "_next_close_epoch_", None),
+                       sorted(getattr(decision, "_epoch_buckets_",
+                                      None) or ()),
+                       len(loader.failed_minibatches),
+                       {s: len(j)
+                        for s, j in dict(loader._pending_).items()},
+                       {s.id: len(s.jobs_in_flight) for s in slaves},
+                       len(decision.epoch_history),
+                       decision.has_data_for_slave,
+                       launcher._server.no_more_jobs),
+                      file=sys.stderr, flush=True)
+            except Exception:
+                # racing live dicts (no locks held on purpose): a torn
+                # read must not kill the diagnostic stream
+                pass
+            time.sleep(2.0)
+
     print("EVENT running t=%.6f" % time.time(), file=sys.stderr,
           flush=True)
-    threading.Thread(target=watch, daemon=True).start()
+    watchers = {"straggler": [watch_straggler],
+                "kill": [watch_kill, watch_epochs],
+                "master-restart": [watch_epochs, watch_state]}[kind]
+    for target in watchers:
+        threading.Thread(target=target, daemon=True).start()
 
 
 def run_slave(port):
@@ -560,6 +671,143 @@ def orchestrate_chaos_straggler():
                 proc.wait()
 
 
+def orchestrate_chaos_kill():
+    """``--chaos kill`` (ISSUE 12): master + 2 CPU slaves on the FC
+    config; once the run is in steady state, SIGKILL one slave
+    MID-EPOCH. The master must requeue the dead slave's in-flight
+    jobs onto the survivor and complete EVERY epoch; the leg measures
+    time-to-drop (fault -> jobs requeued) and time-to-recovery
+    (fault -> first post-fault result merged)."""
+    import signal
+
+    hb = float(os.environ.get("VELES_DIST_HB", 0.5))
+    env = {"VELES_DIST_CONFIG": "fc", "VELES_DIST_HB": str(hb),
+           "VELES_DIST_HBT": os.environ.get("VELES_DIST_HBT", "2.0"),
+           "VELES_DIST_CHAOS": "kill"}
+    master = _spawn("master", 2, tpu=False, extra_env=env)
+    slaves = []
+    try:
+        port = _wait_port(master)
+        slaves = [_spawn("slave", port, tpu=False, extra_env=env,
+                         tag="slave%d" % i) for i in range(2)]
+        _wait_event(master, "running", 900)
+        # let the run reach steady state, then kill INSIDE an epoch
+        # (epochs are served continuously, so any instant is mid-some-
+        # epoch once the first job landed)
+        _wait_event(master, "epoch", 900)
+        victim = slaves[1]
+        t_kill = time.time()
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait()
+        drop = _wait_event(master, "drop", 60)
+        recovered = _wait_event(master, "recovered", 120)
+        dist = _drain(master, "master")
+        survivor = _drain(slaves[0], "slave0", timeout=60)
+        detect_s = float(drop["t"]) - t_kill
+        recovery_s = float(recovered["t"]) - t_kill
+        report = {"mode": "chaos_kill", "config": "fc",
+                  "heartbeat_interval_s": hb,
+                  "time_to_drop_s": round(detect_s, 3),
+                  "time_to_recovery_s": round(recovery_s, 3),
+                  "epochs_completed": dist["epochs"],
+                  "epochs_expected": EPOCHS,
+                  "survivor_ok": bool(survivor and survivor.get("ok"))}
+        print(json.dumps(report))
+        if dist["epochs"] != EPOCHS:
+            raise SystemExit(
+                "kill-mid-epoch run completed %d/%d epochs — the "
+                "recovery plane lost work" % (dist["epochs"], EPOCHS))
+        print("chaos kill leg PASSED: drop in %.2fs, recovery in "
+              "%.2fs, %d/%d epochs with the survivor"
+              % (detect_s, recovery_s, dist["epochs"], EPOCHS),
+              file=sys.stderr)
+    finally:
+        for proc in [master] + slaves:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+def orchestrate_chaos_master_restart():
+    """``--chaos master-restart`` (ISSUE 12): the master checkpoints
+    every closed epoch into an auto-resume directory; after the first
+    snapshot it is SIGKILL'd and a replacement master starts on the
+    SAME port. The slaves must re-handshake through exponential
+    backoff (VELES_RECONNECT_S) and the restored run must complete
+    every remaining epoch — zero hung processes."""
+    import signal
+    import tempfile
+
+    hb = float(os.environ.get("VELES_DIST_HB", 0.5))
+    snapdir = tempfile.mkdtemp(prefix="veles_chaos_resume_")
+    env = {"VELES_DIST_CONFIG": "fc", "VELES_DIST_HB": str(hb),
+           "VELES_DIST_HBT": os.environ.get("VELES_DIST_HBT", "2.0"),
+           "VELES_DIST_CHAOS": "master-restart",
+           "VELES_AUTO_RESUME": snapdir}
+    # the reconnect budget must cover the replacement master's startup
+    # (~20 s CPU init) but stay BELOW the drain timeout: a slave whose
+    # last job outlives the master's end-of-run drain grace redials
+    # for the full budget before exiting
+    slave_env = dict(env, VELES_RECONNECT_S="60")
+    master1 = _spawn("master", 2, tpu=False, extra_env=env)
+    master2 = None
+    slaves = []
+    try:
+        port = _wait_port(master1)
+        slaves = [_spawn("slave", port, tpu=False, extra_env=slave_env,
+                         tag="slave%d" % i) for i in range(2)]
+        _wait_event(master1, "running", 900)
+        first = _wait_event(master1, "epoch", 900)
+        # the snapshot lands in result_sink right after the close the
+        # EVENT announced — wait for the artifact itself
+        deadline = time.time() + 60
+        while not any("_current" in name
+                      for name in os.listdir(snapdir)):
+            if time.time() > deadline:
+                raise RuntimeError("no snapshot appeared in %s"
+                                   % snapdir)
+            time.sleep(0.1)
+        t_kill = time.time()
+        os.kill(master1.pid, signal.SIGKILL)
+        master1.wait()
+        master2 = _spawn("master", 2, port, tpu=False, extra_env=env,
+                         tag="master2")
+        resumed = _wait_event(master2, "resumed", 300)
+        dist = _drain(master2, "master2")
+        slave_oks = []
+        for i, proc in enumerate(slaves):
+            # > the 60 s reconnect budget: a slave whose final compile
+            # outlived the master's drain grace exits within budget
+            leg = _drain(proc, "slave%d" % i, timeout=120)
+            slave_oks.append(bool(leg and leg.get("ok")))
+        recovery_s = float(resumed["t"]) - t_kill
+        report = {"mode": "chaos_master_restart", "config": "fc",
+                  "heartbeat_interval_s": hb,
+                  "epochs_before_kill": int(first["n"]),
+                  "resumed_with_epochs": int(resumed["n"]),
+                  "time_to_resume_s": round(recovery_s, 3),
+                  "epochs_completed": dist["epochs"],
+                  "epochs_expected": EPOCHS,
+                  "slaves_reconnected": slave_oks}
+        print(json.dumps(report))
+        if dist["epochs"] != EPOCHS or not all(slave_oks):
+            raise SystemExit(
+                "master-restart run completed %d/%d epochs, slaves "
+                "ok=%s" % (dist["epochs"], EPOCHS, slave_oks))
+        print("chaos master-restart leg PASSED: resumed with %d "
+              "epoch(s) in %.2fs, finished %d/%d, both slaves "
+              "reconnected and exited cleanly"
+              % (int(resumed["n"]), recovery_s, dist["epochs"],
+                 EPOCHS), file=sys.stderr)
+    finally:
+        for proc in [master1, master2] + slaves:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        import shutil
+        shutil.rmtree(snapdir, ignore_errors=True)
+
+
 def orchestrate_chip():
     env = {"VELES_DIST_CONFIG": CONFIG}
     alone = _drain(_spawn("standalone", tpu=True, extra_env=env),
@@ -589,13 +837,19 @@ def main():
         orchestrate_cpu_protocol()
     elif sys.argv[1] == "--chaos":
         kind = sys.argv[2] if len(sys.argv) > 2 else "straggler"
-        if kind != "straggler":
+        if kind == "straggler":
+            orchestrate_chaos_straggler()
+        elif kind == "kill":
+            orchestrate_chaos_kill()
+        elif kind == "master-restart":
+            orchestrate_chaos_master_restart()
+        else:
             raise SystemExit("unknown chaos kind %r" % kind)
-        orchestrate_chaos_straggler()
     elif sys.argv[1] == "standalone":
         run_standalone()
     elif sys.argv[1] == "master":
-        run_master(int(sys.argv[2]) if len(sys.argv) > 2 else 1)
+        run_master(int(sys.argv[2]) if len(sys.argv) > 2 else 1,
+                   int(sys.argv[3]) if len(sys.argv) > 3 else 0)
     elif sys.argv[1] == "slave":
         run_slave(int(sys.argv[2]))
     elif sys.argv[1] == "shmbench":

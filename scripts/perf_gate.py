@@ -195,6 +195,44 @@ def _federation_probe(n_series=100, beats=50, rounds=3):
     return {"federation_overhead_ratio": fed / max(base, 1e-9)}
 
 
+def _recovery_probe():
+    """ISSUE 12 recovery-time guard (report-only): a loopback
+    coordinator pair where one slave takes a job and dies abruptly
+    (socket closed, no result); measured is the wall time from the
+    death to the requeued job's result arriving from the healthy
+    sibling — the veles_recovery_ms{event="requeue"} path end to
+    end. Report-only because shared CI runners make wall time noisy;
+    the structural assertions live in tests/test_fault_tolerance.py."""
+    from veles_tpu.parallel.coordinator import (CoordinatorClient,
+                                                CoordinatorServer)
+
+    server = CoordinatorServer(checksum="recovery",
+                               heartbeat_timeout=0.5)
+    try:
+        server.submit(*[{"n": i} for i in range(4)])
+        victim = CoordinatorClient(server.address,
+                                   checksum="recovery").connect()
+        victim.proto.send({"cmd": "job"})
+        victim.proto.recv()  # job is now in-flight on the victim
+        t0 = time.perf_counter()
+        # abrupt: kill the raw channels (no goodbye — client.close()
+        # would send the voluntary-exit bye and measure the CLEAN
+        # disconnect instead of a death)
+        victim._closed = True
+        victim._hb_stop.set()
+        victim.proto.close()
+        victim._hb_proto.close()
+        healthy = CoordinatorClient(server.address,
+                                    checksum="recovery").connect()
+        healthy.serve_forever(lambda job: job["n"], max_idle=20)
+        server.wait(4, timeout=20)
+        recovery_s = time.perf_counter() - t0
+        healthy.close()
+    finally:
+        server.stop()
+    return {"recovery_time_s": recovery_s}
+
+
 def capture():
     """Run the probe and return the snapshot dict."""
     from veles_tpu.telemetry import profiler
@@ -227,6 +265,7 @@ def capture():
         metrics["host_rss_gb"] = rss / 2.0 ** 30
     metrics.update(_input_pipeline_probe())
     metrics.update(_federation_probe())
+    metrics.update(_recovery_probe())
     return {"schema": "veles-perf-snapshot/1",
             "probe": {"samples": SAMPLES, "batch": BATCH,
                       "epochs": EPOCHS, "seed": SEED},

@@ -1,0 +1,442 @@
+"""Fault-tolerant elastic training (ISSUE 12): job reassignment on
+slave death/straggling, mid-run elastic join with full-push resync,
+and auto-resume — master restart from the latest snapshot with slaves
+re-handshaking through exponential backoff.
+
+The invariant under test everywhere: **every minibatch trains exactly
+once per epoch, regardless of membership churn** — proven not just by
+epoch accounting but by BIT-level loss-curve equivalence between a
+faulted run and an unfaulted one.
+"""
+
+import copy
+import os
+import threading
+import time
+
+import numpy
+import pytest
+
+from test_mnist_e2e import synthetic_digits
+
+from veles_tpu import prng
+from veles_tpu.launcher import Launcher
+from veles_tpu.models.mnist import MnistWorkflow
+from veles_tpu.parallel.coordinator import (CoordinatorClient,
+                                            CoordinatorServer)
+from veles_tpu.telemetry import health
+from veles_tpu.telemetry.registry import get_registry
+
+
+def _make_workflow(launcher, max_epochs=2):
+    prng.get().seed(42)
+    prng.get("loader").seed(43)
+    # 300+60 samples at minibatch 60 -> 6 jobs per epoch under
+    # segment_size=1: small enough for tier-1, big enough that the
+    # deterministic chaos death (job 8) lands mid-epoch 1
+    return MnistWorkflow(launcher,
+                         provider=synthetic_digits(n_train=300,
+                                                   n_valid=60),
+                         layers=(32,), minibatch_size=60,
+                         learning_rate=0.08, max_epochs=max_epochs)
+
+
+def _normalized_curve(history):
+    return [(h["epoch"], h["validation"]["normalized"],
+             h["train"]["normalized"]) for h in history]
+
+
+# -- tentpole 1: job reassignment -------------------------------------------
+
+
+def _run_leg(fault, max_epochs=2):
+    """One distributed run; with ``fault`` a slave dies MID-EPOCH
+    (deterministically, on its 8th job: 7 merged minibatches into
+    epoch 0) and a fresh slave joins to finish the run.
+
+    ``segment_size=1`` + ``pipeline=False`` is the strict sequential
+    protocol: exactly one job in flight, so the requeued minibatch
+    replays in the exact global position it was lost from and the
+    loss curve must equal the no-fault run BIT FOR BIT.
+
+    (Job 8 of a 6-job epoch: the suicidal slave completes all of
+    epoch 0 plus epoch 1's validation minibatch, then dies holding
+    epoch 1's first train minibatch.)"""
+    prng.get("chaos").seed(7)
+    master = Launcher(listen_address="127.0.0.1:0", graphics=False,
+                      segment_size=1, heartbeat_timeout=1.0)
+    wf_master = _make_workflow(master, max_epochs=max_epochs)
+    master.initialize()
+    port = master._server.address[1]
+
+    if fault:
+        suicidal = Launcher(master_address="127.0.0.1:%d" % port,
+                            graphics=False, pipeline=False,
+                            slave_death_probability=0.073)
+        _make_workflow(suicidal, max_epochs=max_epochs)
+        suicidal.initialize()
+        died = []
+
+        def run_until_chaos_death():
+            try:
+                suicidal.run()
+            except RuntimeError as e:
+                assert "chaos death" in str(e)
+                died.append(True)
+
+        t = threading.Thread(target=run_until_chaos_death, daemon=True)
+        t.start()
+        t.join(timeout=60)
+        assert died, "chaotic slave survived (chaos prng drifted?)"
+        assert suicidal._client.jobs_done == 7, \
+            "expected a deterministic death on job 8, got %d jobs" \
+            % suicidal._client.jobs_done
+
+    healthy = Launcher(master_address="127.0.0.1:%d" % port,
+                       graphics=False, pipeline=False)
+    _make_workflow(healthy, max_epochs=max_epochs)
+    healthy.initialize()
+    slave_thread = threading.Thread(target=healthy.run, daemon=True)
+    slave_thread.start()
+    master.run()
+    slave_thread.join(timeout=60)
+    assert not slave_thread.is_alive()
+    return wf_master.decision.epoch_history
+
+
+def test_kill_mid_epoch_loss_curve_equals_no_fault_run():
+    """ISSUE 12 acceptance: a slave killed mid-epoch must not change
+    the training outcome AT ALL — the requeued minibatches replay in
+    order onto the joining slave, so the per-epoch loss curve of the
+    faulted run equals the unfaulted run exactly."""
+    requeued = get_registry().counter(
+        "veles_jobs_requeued_total",
+        "In-flight jobs requeued after a slave was dropped",
+        labels=("reason",))
+    drops = get_registry().counter(
+        "veles_slave_drops_total", "Slaves dropped (death/timeout)")
+    before = requeued.labels(reason="dead").value
+    drops_before = drops.value
+
+    reference = _run_leg(fault=False)
+    faulted = _run_leg(fault=True)
+
+    assert [h["epoch"] for h in reference] == [0, 1]
+    assert _normalized_curve(faulted) == _normalized_curve(reference)
+    # the abrupt socket death is counted as a DEATH (the slave_dead
+    # alert keys on the drops counter), and its job was requeued
+    assert requeued.labels(reason="dead").value > before
+    assert drops.value > drops_before
+
+
+def test_straggler_drop_requeues_jobs():
+    """The reaction layer on PR 9's detection: a slave the scorer has
+    held in ``straggler`` state past the grace window is dropped and
+    its in-flight jobs go back on the queue for healthy slaves."""
+    health.reset_scorer()
+    registry = get_registry()
+    requeued = registry.counter(
+        "veles_jobs_requeued_total",
+        "In-flight jobs requeued after a slave was dropped",
+        labels=("reason",))
+    before = requeued.labels(reason="straggler").value
+    server = CoordinatorServer(checksum="s", straggler_drop_s=0.0,
+                               heartbeat_timeout=30.0)
+    try:
+        server.submit({"x": 1})
+        victim = CoordinatorClient(server.address,
+                                   checksum="s").connect()
+        victim.proto.send({"cmd": "job"})
+        reply = victim.proto.recv()
+        assert reply["job"] == {"x": 1}  # victim now holds it in-flight
+        # force the scorer's verdict (the organic path — peer-median
+        # scoring with hysteresis — is pinned by tests/test_alerts.py;
+        # here the REACTION is under test)
+        scorer = server.health
+        scorer.observe(victim.id, beat=True)
+        with scorer._lock:
+            st = scorer._slaves[victim.id]
+            st.state = "straggler"
+            st.since = time.monotonic() - 10.0
+        deadline = time.time() + 10.0
+        while victim.id in server.slaves and time.time() < deadline:
+            time.sleep(0.05)
+        assert victim.id not in server.slaves, \
+            "straggler was never dropped"
+        assert requeued.labels(reason="straggler").value == before + 1
+        # a healthy slave completes the requeued job
+        healthy = CoordinatorClient(server.address,
+                                    checksum="s").connect()
+        healthy.serve_forever(lambda job: job["x"] * 10, max_idle=10)
+        assert server.wait(1, timeout=5) == [10]
+        victim.close()
+        healthy.close()
+    finally:
+        server.stop()
+        health.reset_scorer()
+
+
+# -- tentpole 2: elastic join ------------------------------------------------
+
+
+def _master_workflow(max_epochs=4):
+    master = Launcher(listen_address="127.0.0.1:0", graphics=False)
+    wf = _make_workflow(master, max_epochs=max_epochs)
+    wf.initialize(device=None)
+    wf.stopped = False  # what _start_master does before serving jobs
+    return wf
+
+
+def _slave_workflow(max_epochs=4, seed=42):
+    from veles_tpu.backends import Device
+    slave = Launcher(master_address="127.0.0.1:1", graphics=False)
+    prng.get().seed(seed)
+    prng.get("loader").seed(seed + 1)
+    wf = MnistWorkflow(slave, provider=synthetic_digits(),
+                       layers=(32,), minibatch_size=60,
+                       learning_rate=0.08, max_epochs=max_epochs)
+    wf.initialize(device=Device(backend=None))
+    return wf
+
+
+def test_mid_run_join_first_jobs_bit_consistent():
+    """A slave joining mid-run receives the full-push resync (weights
+    + cursors + PRNG) in its handshake; its FIRST job must produce an
+    update bit-identical to what a resident slave would compute for
+    the same job."""
+    wf_master = _master_workflow()
+    resident = _slave_workflow()
+
+    # run a few jobs on the resident slave so the master's state has
+    # genuinely moved off initialization
+    for _ in range(5):
+        job = wf_master.generate_data_for_slave("resident")
+        assert job is not None
+        update = resident.do_job(copy.deepcopy(job))
+        wf_master.apply_data_from_slave(update, "resident")
+
+    # the joiner is built with DIFFERENT seeds: everything that makes
+    # its first job bit-consistent must come from the resync push,
+    # not from accidentally shared initial state
+    joiner = _slave_workflow(seed=777)
+    joiner.apply_initial_data_from_master({
+        "units": wf_master.generate_initial_data_for_slave("joiner"),
+        "resync": wf_master.generate_resync_for_slave("joiner")})
+    assert joiner.loader.epoch_number == wf_master.loader.epoch_number
+
+    job = wf_master.generate_data_for_slave("joiner")
+    update_resident = resident.do_job(copy.deepcopy(job))
+    update_joiner = joiner.do_job(copy.deepcopy(job))
+
+    compared = 0
+    for (name_r, pay_r), (name_j, pay_j) in zip(update_resident,
+                                                update_joiner):
+        assert name_r == name_j
+        if name_r == wf_master.loader.name:
+            continue  # cumulative served counters legitimately differ
+        if isinstance(pay_r, dict) and any(
+                isinstance(v, numpy.ndarray) for v in pay_r.values()):
+            for key in pay_r:
+                numpy.testing.assert_array_equal(
+                    pay_r[key], pay_j[key],
+                    err_msg="%s[%s] diverged" % (name_r, key))
+                compared += 1
+        else:
+            assert pay_r == pay_j, name_r
+            compared += 1
+    assert compared >= 5  # weights of both layers + decision stats
+
+
+def test_prng_dump_restore_roundtrip():
+    """The resync's PRNG block continues the exact stream."""
+    gen = prng.get("ft-test")
+    gen.seed(123)
+    gen.rand()  # advance off the seed point
+    states = prng.dump_states()
+    expect_host = [gen.rand() for _ in range(3)]
+    expect_key = gen.jax_key()
+    prng.restore_states(states)
+    got_host = [prng.get("ft-test").rand() for _ in range(3)]
+    got_key = prng.get("ft-test").jax_key()
+    assert got_host == expect_host
+    assert numpy.array_equal(numpy.asarray(got_key),
+                             numpy.asarray(expect_key))
+
+
+def test_elastic_join_counts_and_completes():
+    """End-to-end elastic join over the real socket protocol: a second
+    slave attaches while the epoch is in progress, takes jobs without
+    an epoch restart, and every epoch still closes exactly once."""
+    registry = get_registry()
+    joins = registry.counter("veles_slave_joins_total",
+                             "Successful slave handshakes",
+                             labels=("kind",))
+    mid_before = joins.labels(kind="mid_run").value
+    master = Launcher(listen_address="127.0.0.1:0", graphics=False,
+                      segment_size=2)
+    wf_master = _make_workflow(master, max_epochs=3)
+    master.initialize()
+    port = master._server.address[1]
+
+    first = Launcher(master_address="127.0.0.1:%d" % port,
+                     graphics=False)
+    _make_workflow(first, max_epochs=3)
+    first.initialize()
+    t1 = threading.Thread(target=first.run, daemon=True)
+    t1.start()
+
+    # wait until the run is demonstrably in progress, then join
+    deadline = time.time() + 60
+    while not master._server._jobs_handed and time.time() < deadline:
+        time.sleep(0.02)
+    assert master._server._jobs_handed
+
+    late = Launcher(master_address="127.0.0.1:%d" % port,
+                    graphics=False)
+    _make_workflow(late, max_epochs=3)
+    late.initialize()
+    t2 = threading.Thread(target=late.run, daemon=True)
+    t2.start()
+
+    master.run()
+    for t in (t1, t2):
+        t.join(timeout=90)
+        assert not t.is_alive()
+    history = wf_master.decision.epoch_history
+    assert [h["epoch"] for h in history] == [0, 1, 2], history
+    total = sum(wf_master.loader.class_lengths)
+    for h in history:
+        served = sum(h[k]["samples"] for k in ("validation", "train")
+                     if k in h)
+        assert served == total, h
+    assert joins.labels(kind="mid_run").value > mid_before
+    assert late._client.jobs_done > 0, \
+        "the late joiner never took a job"
+
+
+# -- tentpole 3: auto-resume -------------------------------------------------
+
+
+def test_initial_connect_retries_until_master_binds():
+    """A slave started before its master must dial with backoff
+    instead of dying on ConnectionRefused."""
+    import socket as socket_mod
+    probe = socket_mod.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    started = {}
+
+    def bind_late():
+        time.sleep(1.0)
+        started["server"] = CoordinatorServer(
+            address=("127.0.0.1", port), checksum="late")
+
+    t = threading.Thread(target=bind_late, daemon=True)
+    t.start()
+    client = CoordinatorClient(("127.0.0.1", port), checksum="late",
+                               connect_retry_s=20.0)
+    t0 = time.monotonic()
+    client.connect()  # would raise instantly without the retry budget
+    assert time.monotonic() - t0 >= 0.5
+    assert client.id is not None
+    client.close()
+    started["server"].stop()
+
+
+def test_client_reconnects_to_restarted_master():
+    """Mid-run master loss: with a reconnect budget the slave
+    re-handshakes (new id) against the restarted master and keeps
+    serving jobs; without one it would have returned at the first
+    ConnectionError."""
+    server1 = CoordinatorServer(checksum="rr")
+    port = server1.address[1]
+    server1.submit(*[{"n": i} for i in range(3)])
+    client = CoordinatorClient(server1.address, checksum="rr",
+                               reconnect_s=30.0).connect()
+    first_id = client.id
+    done = {}
+
+    def serve():
+        done["jobs"] = client.serve_forever(lambda job: job["n"],
+                                            max_idle=None)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert sorted(server1.wait(3, timeout=10)) == [0, 1, 2]
+    server1.stop()  # the crash: client polls now hit ConnectionError
+    time.sleep(0.3)
+    server2 = CoordinatorServer(address=("127.0.0.1", port),
+                                checksum="rr")
+    try:
+        server2.submit(*[{"n": i} for i in range(3, 5)])
+        server2.no_more_jobs = True
+        t.join(timeout=30)
+        assert not t.is_alive(), "client never finished after restart"
+        assert done["jobs"] == 5
+        assert client.reconnects == 1
+        assert client.id != first_id  # a fresh handshake, not a ghost
+        assert sorted(server2.wait(2, timeout=10)) == [3, 4]
+    finally:
+        client.close()
+        server2.stop()
+
+
+def test_master_restart_auto_resume(tmp_path):
+    """The full auto-resume loop in one process: the master
+    checkpoints on every epoch close, 'crashes', and a replacement
+    master on the same port restores the latest snapshot; the slave
+    re-handshakes through backoff and the run completes every epoch
+    exactly once past the restore point. (The cross-process variant
+    is ``bench_distributed.py --chaos master-restart``.)"""
+    snapdir = str(tmp_path / "snaps")
+    master1 = Launcher(listen_address="127.0.0.1:0", graphics=False,
+                       auto_resume=snapdir, heartbeat_timeout=2.0)
+    _make_workflow(master1, max_epochs=4)
+    master1.initialize()
+    port = master1._server.address[1]
+
+    slave = Launcher(master_address="127.0.0.1:%d" % port,
+                     graphics=False, reconnect_s=60.0)
+    _make_workflow(slave, max_epochs=4)
+    slave.initialize()
+    slave_thread = threading.Thread(target=slave.run, daemon=True)
+    slave_thread.start()
+
+    # jobs flow from the coordinator threads (run() only waits), so
+    # the first epoch closes — and snapshots — without master1.run()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if master1._last_snap_epochs >= 1:
+            break
+        time.sleep(0.05)
+    assert master1._last_snap_epochs >= 1, "no epoch snapshot appeared"
+    epochs_before = len(master1.workflow.decision.epoch_history)
+    master1._server.stop()  # the crash — no clean drain, no goodbye
+
+    master2 = Launcher(listen_address="127.0.0.1:%d" % port,
+                       graphics=False, auto_resume=snapdir,
+                       heartbeat_timeout=2.0)
+    _make_workflow(master2, max_epochs=4)
+    master2.initialize()
+    assert master2._resumed_from, "master2 did not restore a snapshot"
+    wf2 = master2.workflow  # the RESTORED workflow, not the built one
+    assert len(wf2.decision.epoch_history) >= 1
+    master2.run()
+    slave_thread.join(timeout=120)
+    assert not slave_thread.is_alive(), "slave hung after restart"
+    assert slave._client.reconnects >= 1
+
+    history = wf2.decision.epoch_history
+    assert [h["epoch"] for h in history] == [0, 1, 2, 3], history
+    total = sum(wf2.loader.class_lengths)
+    for h in history:
+        served = sum(h[k]["samples"] for k in ("validation", "train")
+                     if k in h)
+        assert served == total, h
+    assert epochs_before <= len(history)
+    # the restore leg recorded its recovery time
+    recovery = get_registry().get("veles_recovery_ms")
+    assert recovery is not None
+    assert recovery.labels(event="restore").count >= 1

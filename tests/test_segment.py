@@ -183,10 +183,24 @@ def test_chaos_death_with_segments_requeues():
     _make_workflow(suicidal, max_epochs=2)
     suicidal.initialize()
 
-    # run the chaotic slave until it kills itself, then a healthy one
-    t = threading.Thread(target=suicidal.run, daemon=True)
+    # run the chaotic slave until it kills itself, then a healthy one.
+    # The intentional chaos death is swallowed INSIDE the thread: an
+    # unhandled thread exception would raise pytest's
+    # PytestUnhandledThreadExceptionWarning and drown a real stray
+    # failure (VERDICT r5 weak #6)
+    died = []
+
+    def run_until_chaos_death():
+        try:
+            suicidal.run()
+        except RuntimeError as e:
+            assert "chaos death" in str(e)
+            died.append(True)
+
+    t = threading.Thread(target=run_until_chaos_death, daemon=True)
     t.start()
     t.join(timeout=30)
+    assert died, "chaotic slave survived its own death probability"
 
     healthy = Launcher(master_address="127.0.0.1:%d" % port,
                        graphics=False)

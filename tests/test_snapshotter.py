@@ -288,3 +288,74 @@ def test_http_snapshot_restore(tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_restore_latest_falls_back_past_corrupt_artifact(tmp_path):
+    """ISSUE 12: a truncated/corrupt newest snapshot must not crash
+    the auto-resume — the previous artifact loads instead."""
+    import time as time_mod
+
+    from veles_tpu.snapshotter import restore_latest, save_snapshot
+
+    wf = build(max_epochs=1)
+    wf.run()
+    good_path, _ = save_snapshot(wf, str(tmp_path))
+    time_mod.sleep(0.05)  # newer mtime for the corrupt artifact
+    bad = tmp_path / "wf.99.pickle.gz"
+    bad.write_bytes(b"\x1f\x8b garbage, not even valid gzip")
+    # point the _current link at the corrupt file, like a torn export
+    current = tmp_path / "wf_current.pickle.gz"
+    current.unlink()
+    current.symlink_to(bad.name)
+
+    restored, path = restore_latest(str(tmp_path))
+    assert path == good_path
+    for a, b in zip(weights_of(wf), weights_of(restored)):
+        numpy.testing.assert_array_equal(a, b)
+
+
+def test_restore_latest_rejects_non_snapshot_pickles(tmp_path):
+    """A pickle that loads but is not a snapshot stream fails the
+    integrity check and falls through like any corrupt artifact."""
+    import pickle
+    import time as time_mod
+
+    from veles_tpu.snapshotter import restore_latest, save_snapshot
+
+    wf = build(max_epochs=1)
+    wf.run()
+    good_path, _ = save_snapshot(wf, str(tmp_path))
+    time_mod.sleep(0.05)
+    (tmp_path / "wf_current.pickle.gz").unlink()
+    (tmp_path / "wf.77.pickle").write_bytes(
+        pickle.dumps({"not": "a snapshot"}))
+    restored, path = restore_latest(str(tmp_path))
+    assert path == good_path
+
+
+def test_latest_snapshot_skips_in_progress_temp_files(tmp_path):
+    """An exporter crash mid-write leaves only hidden .tmp staging
+    debris; neither latest_snapshot nor restore_latest may pick it."""
+    from veles_tpu.snapshotter import (latest_snapshot, restore_latest,
+                                       save_snapshot)
+
+    wf = build(max_epochs=1)
+    wf.run()
+    good_path, _ = save_snapshot(wf, str(tmp_path))
+    (tmp_path / ".stage123.tmp").write_bytes(b"half-written")
+    (tmp_path / "torn.pickle.tmp").write_bytes(b"also debris")
+    assert latest_snapshot(str(tmp_path)) == good_path
+    _, path = restore_latest(str(tmp_path))
+    assert path == good_path
+    with pytest.raises(FileNotFoundError):
+        latest_snapshot(str(tmp_path), prefix="nonexistent")
+
+
+def test_restore_latest_no_loadable_raises(tmp_path):
+    from veles_tpu.snapshotter import restore_latest
+
+    with pytest.raises(FileNotFoundError):
+        restore_latest(str(tmp_path))
+    (tmp_path / "wf.1.pickle").write_bytes(b"junk")
+    with pytest.raises(FileNotFoundError, match="no loadable"):
+        restore_latest(str(tmp_path))

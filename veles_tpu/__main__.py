@@ -178,6 +178,9 @@ class Main(Logger):
             "interactive": getattr(args, "interactive", False),
             "exchange_dtype": getattr(args, "exchange_dtype", "none"),
             "exchange_eps": getattr(args, "exchange_eps", 0.0),
+            "auto_resume": getattr(args, "auto_resume", None),
+            "straggler_drop_s": getattr(args, "straggler_drop_s", None),
+            "reconnect_s": getattr(args, "reconnect_s", None),
         }
         if args.listen_address:
             kwargs["listen_address"] = args.listen_address

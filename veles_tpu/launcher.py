@@ -73,7 +73,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         "nodes", "respawn", "slave_command", "eager", "segment_size",
         "pipeline", "secret", "secret_file", "max_frame_mb",
         "interactive", "exchange_dtype", "exchange_eps",
-        "heartbeat_interval",
+        "heartbeat_interval", "auto_resume", "straggler_drop_s",
+        "reconnect_s",
     ])
 
     def __init__(self, **kwargs):
@@ -100,6 +101,33 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         #: beat's RTT, aggregated on the master per slave)
         self.heartbeat_interval = kwargs.get("heartbeat_interval", 2.0)
         self.max_idle = kwargs.get("max_idle")
+        import os as os_mod
+        #: fault-tolerance knobs (ISSUE 12, docs/FAULT_TOLERANCE.md):
+        #: auto_resume = snapshot directory the master checkpoints to
+        #: on every epoch close and restores from on restart
+        self.auto_resume = kwargs.get("auto_resume") or \
+            os_mod.environ.get("VELES_AUTO_RESUME") or None
+        #: master: drop (and requeue the jobs of) a slave held in the
+        #: health scorer's straggler state this long (None = alert
+        #: only). None-aware fallbacks throughout: the CLI always
+        #: passes these kwargs (argparse defaults are None), so a
+        #: plain dict.get default would shadow the env knobs
+        drop_s = kwargs.get("straggler_drop_s")
+        if drop_s is None:
+            drop_s = os_mod.environ.get("VELES_STRAGGLER_DROP_S")
+        self.straggler_drop_s = None if drop_s in (None, "") \
+            else float(drop_s)
+        #: slave: on master loss mid-run, re-handshake with exponential
+        #: backoff + jitter for up to this many seconds (the window a
+        #: restarted master needs to restore its snapshot and re-bind)
+        reconnect_s = kwargs.get("reconnect_s")
+        if reconnect_s in (None, ""):
+            reconnect_s = os_mod.environ.get("VELES_RECONNECT_S") or 30.0
+        self.reconnect_s = float(reconnect_s)
+        self._resumed_from = None
+        self._resume_complete = False
+        self._last_snap_epochs = 0
+        self._snapshot_lock = threading.Lock()
         self.nodes = kwargs.get("nodes")
         self.respawn = kwargs.get("respawn", False)
         self.eager = kwargs.get("eager", False)
@@ -229,6 +257,25 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             help="with --exchange-dtype: also skip leaves whose "
                  "largest delta magnitude is <= EPS (default 0: skip "
                  "only exactly-unchanged leaves)")
+        parser.add_argument(
+            "--auto-resume", dest="auto_resume", default=None,
+            metavar="DIR",
+            help="master: snapshot to DIR on every epoch close and, "
+                 "on restart, resume from the latest loadable snapshot "
+                 "there (VELES_AUTO_RESUME env is the fallback)")
+        parser.add_argument(
+            "--straggler-drop-s", dest="straggler_drop_s", type=float,
+            default=None,
+            help="master: requeue the jobs of (and drop) a slave the "
+                 "health scorer has flagged straggler for this many "
+                 "seconds (default: alert only)")
+        parser.add_argument(
+            "--reconnect-s", dest="reconnect_s", type=float,
+            default=None,
+            help="slave: when the master vanishes mid-run, retry the "
+                 "handshake with exponential backoff for up to this "
+                 "many seconds before giving up (0 disables; default "
+                 "30, VELES_RECONNECT_S env overrides)")
         return parser
 
     # -- mode --------------------------------------------------------------
@@ -284,6 +331,11 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             self.device = Device(backend=self.backend)
         if self.graphics and not root.common.disable.get("plotting", True):
             self._launch_graphics()
+        if self.auto_resume and not self.is_slave:
+            # replaces self.workflow when a loadable snapshot exists;
+            # must run before the finished callback / initialize below
+            # so the RESTORED graph gets them
+            self._try_auto_resume()
         self.workflow.add_finished_callback(self.on_workflow_finished)
         if self.testing:
             set_testing = getattr(self.workflow, "set_testing", None)
@@ -292,8 +344,21 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             else:
                 self.warning("--test requested but %s has no set_testing",
                              type(self.workflow).__name__)
+        # read BEFORE workflow.initialize: the units consume their
+        # restored markers there
+        was_restored = bool(getattr(self.workflow,
+                                    "_restored_from_snapshot_", False))
         self.workflow.initialize(device=self.device, **kwargs)
         if self.is_master:
+            if was_restored and self._resumed_from is None:
+                # ANY snapshot-restored master (-w snap, manual
+                # import_, not just --auto-resume) rewinds to the last
+                # closed epoch boundary: a snapshot dumped while
+                # run-ahead results were being merged-then-cancelled
+                # has consumed minibatches of epochs that never
+                # closed — without the rewind those epochs can never
+                # complete on sample counts and the resumed run wedges
+                self._prepare_master_resume(self.workflow)
             self._start_master()
         elif self.is_slave:
             self._connect_slave()
@@ -301,6 +366,99 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             self._start_status_notifier()
             self._attach_dashboard_sinks()
         return self
+
+    def _try_auto_resume(self):
+        """Master restart (ISSUE 12 tentpole part 3): restore the
+        newest loadable snapshot from the auto-resume directory and
+        rewind to the last closed epoch boundary, so a master that
+        died mid-run comes back and the epoch replays instead of
+        hanging half-merged. A corrupt newest artifact falls back to
+        the previous one (snapshotter.restore_latest)."""
+        from veles_tpu import snapshotter as snap_mod
+        t0 = time.perf_counter()
+        try:
+            restored, path = snap_mod.restore_latest(self.auto_resume)
+        except FileNotFoundError as e:
+            self.info("auto-resume: %s — starting fresh", e)
+            return
+        restored.workflow = self  # re-bind to this launcher
+        self.workflow = restored
+        self._resumed_from = path
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        get_registry().histogram(
+            "veles_recovery_ms",
+            "Fault detection to training progress resumed",
+            labels=("event",)).labels(event="restore").observe(elapsed_ms)
+        history = getattr(getattr(restored, "decision", None),
+                          "epoch_history", [])
+        self.info("auto-resumed from %s in %.0f ms (%d epoch(s) "
+                  "closed)", path, elapsed_ms, len(history))
+        if self.is_master:
+            self._prepare_master_resume(restored)
+
+    def _prepare_master_resume(self, wf):
+        """On a master the transient merge buckets died with the old
+        process: rewind to the last closed epoch boundary and replay
+        (the snapshot's own shuffle state makes the replay serve the
+        identical index order)."""
+        decision = getattr(wf, "decision", None)
+        loader = getattr(wf, "loader", None)
+        if decision is None or loader is None:
+            return
+        resume_epoch = decision.prepare_resume()
+        if resume_epoch is None:
+            self.info("restored run is already complete; nothing to "
+                      "resume")
+            self._resume_complete = True
+            return
+        loader.reset_to_epoch_start(resume_epoch)
+        self._last_snap_epochs = len(decision.epoch_history)
+        self.info("master resume: replaying epoch %d from its start",
+                  resume_epoch)
+
+    def _maybe_master_snapshot(self):
+        """Master-side snapshot cadence: one snapshot per CLOSED epoch
+        into the auto-resume directory (called from result_sink after
+        each merge — the master's graph never executes, so the
+        Snapshotter unit cannot gate here; adding one would also
+        change the checksum slaves handshake against)."""
+        wf = self.workflow
+        decision = getattr(wf, "decision", None)
+        if decision is None:
+            return
+        if len(decision.epoch_history) <= self._last_snap_epochs:
+            return
+        if not self._snapshot_lock.acquire(blocking=False):
+            return  # a sibling result thread is already dumping
+        try:
+            if len(decision.epoch_history) <= self._last_snap_epochs:
+                return
+            from contextlib import ExitStack
+            from veles_tpu.snapshotter import (dump_workflow,
+                                               save_snapshot)
+            with ExitStack() as stack:
+                # a SIBLING result thread may be mid-merge (result_sink
+                # runs outside the coordinator lock by design): hold
+                # every unit's data lock for the IN-MEMORY dump so no
+                # weight array is pickled half-applied. Deadlock-free:
+                # merge threads take ONE unit lock at a time and never
+                # wait on the snapshot lock. The compress+disk write
+                # happens AFTER release — merges must not stall on I/O.
+                for unit in wf._distributed_units():
+                    lock = getattr(unit, "_data_lock_", None)
+                    if lock is not None:
+                        stack.enter_context(lock)
+                payload = dump_workflow(wf)
+            path, nbytes = save_snapshot(wf, self.auto_resume,
+                                         payload=payload)
+            self._last_snap_epochs = len(decision.epoch_history)
+            self.info("master snapshot -> %s (%.1f MiB, %d epoch(s))",
+                      path, nbytes / 1048576.0, self._last_snap_epochs)
+        except Exception:
+            # checkpointing must never kill training
+            self.warning("master snapshot failed", exc_info=True)
+        finally:
+            self._snapshot_lock.release()
 
     def _launch_graphics(self):
         try:
@@ -399,13 +557,32 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             m_bytes.labels(slave=slave.id, direction="from_slave").inc(
                 _blob_nbytes(data["blob"]))
             workflow.apply_data_from_slave(payload, slave)
+            if self.auto_resume:
+                # one snapshot per closed epoch: the restart point
+                self._maybe_master_snapshot()
 
         def on_drop(slave):
             workflow.drop_slave(slave)
 
         def initial_data_source(slave):
-            return _encode(workflow.generate_initial_data_for_slave(slave),
-                           compress=not slave.sharedio)
+            payload = workflow.generate_initial_data_for_slave(slave)
+            loader = getattr(workflow, "loader", None)
+            decision = getattr(workflow, "decision", None)
+            mid_run = bool(
+                getattr(loader, "_global_offset", 0) or
+                getattr(loader, "epoch_number", 0) or
+                getattr(decision, "epoch_history", None))
+            if mid_run and hasattr(workflow,
+                                   "generate_resync_for_slave"):
+                # elastic join (ISSUE 12): a slave entering a run in
+                # progress gets the FULL live state in its handshake —
+                # weights, decision state, epoch cursors, PRNG streams
+                # — so its first job is indistinguishable from a
+                # resident slave's
+                payload = {
+                    "units": payload,
+                    "resync": workflow.generate_resync_for_slave(slave)}
+            return _encode(payload, compress=not slave.sharedio)
 
         def on_slave_flight(sid, notice):
             # a slave's flight recorder tripped: dump ONE cluster
@@ -442,7 +619,12 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             job_source=job_source, result_sink=result_sink,
             on_drop=on_drop, initial_data_source=initial_data_source,
             secret=self.secret, max_frame=self.max_frame,
-            on_slave_flight=on_slave_flight)
+            on_slave_flight=on_slave_flight,
+            straggler_drop_s=self.straggler_drop_s)
+        if self._resume_complete:
+            # the restored run had already finished: serve "done" to
+            # every reconnecting slave instead of retraining
+            self._server.no_more_jobs = True
         # every span this master records carries the run's trace id;
         # slaves adopt the same id from the handshake reply
         tracing.set_default_trace_id(self._server.trace_id)
@@ -520,7 +702,20 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             death_probability=self.slave_death_probability,
             pipeline=self.pipeline, secret=self.secret,
             max_frame=self.max_frame,
-            heartbeat_interval=self.heartbeat_interval)
+            heartbeat_interval=self.heartbeat_interval,
+            reconnect_s=self.reconnect_s)
+
+        def on_reconnect(client):
+            # the client re-handshook with a (possibly restarted)
+            # master: adopt its trace id and re-apply its initial
+            # data / full-push resync exactly like a fresh join
+            if client.trace_id:
+                tracing.set_default_trace_id(client.trace_id)
+            if client.initial_data is not None:
+                self.workflow.apply_initial_data_from_master(
+                    _decode(client.initial_data))
+
+        self._client.on_reconnect = on_reconnect
         self._client.connect()
         if self._client.trace_id:
             # adopt the master's run-wide trace id: this slave's unit/
@@ -639,6 +834,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             "slaves": slaves,
             "units": len(wf) if wf else 0,
             "stopped": self.stopped,
+            "resumed_from": self._resumed_from,
             "perf": perf,
             "cluster": cluster,
             "graph": getattr(self, "_graph_cache", None),
@@ -689,6 +885,14 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                     s.current_job or s.applying
                     for s in self._server.snapshot_slaves()):
                 self._finished.set()
+        # drain grace: let idle slaves collect their "done" replies
+        # and disconnect cleanly — killing the server under a slave
+        # mid-poll reads as a master CRASH on its side, and a slave
+        # with a reconnect budget (--reconnect-s) would burn all of
+        # it redialing a master that is gone on purpose
+        deadline = time.time() + 5.0
+        while self._server.snapshot_slaves() and time.time() < deadline:
+            time.sleep(0.05)
 
     def _run_slave(self):
         workflow = self.workflow

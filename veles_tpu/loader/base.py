@@ -315,6 +315,37 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         for job in self._pending_.pop(sid, []):
             self.failed_minibatches.append(job)
 
+    def reset_to_epoch_start(self, epoch):
+        """Rewind the serving cursor to the START of ``epoch``,
+        discarding partial-epoch progress (pending registrations,
+        requeues, epoch flags).
+
+        The master-restart auto-resume path (ISSUE 12): a snapshot
+        taken at an epoch boundary may still carry the cursor partway
+        into the next epoch (run-ahead jobs in flight at dump time),
+        but the merge buckets for that partial epoch died with the old
+        master — replaying the epoch from its start is the only way
+        sample-count epoch closing can complete it. When the cursor
+        already wrapped into (or past) ``epoch``, the snapshot's own
+        shuffle state makes the replay serve the same index order the
+        lost jobs had; when the snapshot landed BEFORE the lazy wrap
+        (epoch e closed, no e+1 job generated yet), the wrap is
+        replayed here so the resumed epoch trains on ITS shuffle, not
+        the previous epoch's, and the shuffle PRNG stream does not
+        skip a draw."""
+        epoch = int(epoch)
+        while self.epoch_number < epoch:
+            # the lazy epoch wrap (_finish_epoch) the old master never
+            # reached: advance the counter AND draw its reshuffle
+            self._finish_epoch()
+        self.epoch_number = epoch
+        self._global_offset = 0
+        self.failed_minibatches = []
+        self._pending_ = {}
+        self.last_minibatch <<= False
+        self.train_ended <<= False
+        self.epoch_ended <<= False
+
     @staticmethod
     def init_parser(parser):
         parser.add_argument(

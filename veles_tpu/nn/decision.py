@@ -109,6 +109,32 @@ class DecisionBase(Unit, IResultProvider):
         # it must re-arm the loop gate the previous job closed
         return {"reset_complete": True}
 
+    def prepare_resume(self):
+        """Master-restart resume (ISSUE 12): re-arm epoch accounting
+        after a snapshot restore.
+
+        Returns the epoch the run should resume FROM (the one after
+        the last closed epoch), or ``None`` when the restored run had
+        already completed — the launcher then finishes immediately
+        instead of retraining the final epoch. The transient merge
+        buckets (``_epoch_buckets_`` etc.) died with the old master by
+        design; ``_next_close_epoch_`` re-derives from epoch_history
+        on the first merged update, so all that needs doing here is
+        clearing the stop/throttle state the pickle carried."""
+        last_closed = max((h["epoch"] for h in self.epoch_history),
+                          default=-1)
+        if bool(self.complete) and self.max_epochs is not None and \
+                last_closed + 1 >= self.max_epochs:
+            return None
+        self.complete <<= False
+        self.improved <<= False
+        self._stop_epoch_ = None
+        self.has_data_for_slave = True
+        # epoch_number is linked from the loader; the caller rewinds
+        # the loader cursor (reset_to_epoch_start) and this unit reads
+        # it back through the link
+        return last_closed + 1
+
     def apply_data_from_master(self, data):
         if data.get("reset_complete"):
             self.complete <<= False

@@ -43,6 +43,44 @@ def _blob_len(data):
     return data.nbytes if isinstance(data, wire.Chunks) else len(data)
 
 
+#: shm segment names CREATED by this process (Protocol senders, the
+#: same-host challenge). An in-process peer (master+slave in one
+#: process: tests, the dryrun) that attaches to one of these must NOT
+#: deregister it from the resource tracker — register/unregister is a
+#: plain set in the tracker, so the receiver's unregister would erase
+#: the OWNER's registration and the owner's later unlink would
+#: double-unregister, spraying ``KeyError: '/psm_...'`` tracebacks
+#: from the tracker process at teardown (VERDICT r5 weak #2).
+_OWNED_SHM = set()
+_OWNED_SHM_LOCK = threading.Lock()
+
+
+def _own_segment(seg):
+    with _OWNED_SHM_LOCK:
+        _OWNED_SHM.add(seg._name)
+    return seg
+
+
+def _disown_segment(seg):
+    with _OWNED_SHM_LOCK:
+        _OWNED_SHM.discard(seg._name)
+
+
+def _unregister_foreign(seg):
+    """Drop the tracker registration CPython adds on every attach —
+    the sender owns the segment — unless this very process is the
+    sender (in-process peer), whose registration must survive for its
+    own unlink."""
+    with _OWNED_SHM_LOCK:
+        if seg._name in _OWNED_SHM:
+            return
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:
+        pass
+
+
 class NoMoreJobsError(Exception):
     """Raised by a ``job_source`` when the workflow ran out of work."""
 
@@ -129,13 +167,14 @@ class Protocol(object):
         if seg is not None:  # regrow
             seg.close()
             seg.unlink()
+            _disown_segment(seg)
             self.shm_regrows += 1
         # 25% slack so payloads whose size oscillates between cycles
         # (delta pushes vs full pushes, varying batch counts) reuse the
         # segment instead of regrowing every other send
-        seg = shared_memory.SharedMemory(
+        seg = _own_segment(shared_memory.SharedMemory(
             create=True,
-            size=max(size + (size >> 2), self.SHM_THRESHOLD))
+            size=max(size + (size >> 2), self.SHM_THRESHOLD)))
         self._segments[turn] = seg
         return seg
 
@@ -284,15 +323,12 @@ class Protocol(object):
             seg = shared_memory.SharedMemory(name=value["__shm__"])
         except (OSError, ValueError) as e:
             raise ConnectionError("stale sharedio ref: %s" % e)
-        try:
-            # CPython's SharedMemory registers every attach with THIS
-            # process's resource tracker, which would unlink the
-            # sender's live segment when we exit — deregister: the
-            # sender owns the segment
-            from multiprocessing import resource_tracker
-            resource_tracker.unregister(seg._name, "shared_memory")
-        except Exception:
-            pass
+        # CPython's SharedMemory registers every attach with THIS
+        # process's resource tracker, which would unlink the sender's
+        # live segment when we exit — deregister (unless this process
+        # IS the sender: an in-process peer must not erase the owner's
+        # registration)
+        _unregister_foreign(seg)
         try:
             off = int(value.get("off", 0))
             size = int(value["size"])
@@ -348,6 +384,7 @@ class Protocol(object):
                     seg.unlink()
                 except (OSError, FileNotFoundError):
                     pass
+                _disown_segment(seg)
                 self._segments[i] = None
 
 
@@ -364,7 +401,8 @@ def _prove_same_host(proto):
     from multiprocessing import shared_memory
     raw = secrets.token_bytes(32)
     try:
-        seg = shared_memory.SharedMemory(create=True, size=64)
+        seg = _own_segment(shared_memory.SharedMemory(create=True,
+                                                      size=64))
     except OSError:
         return False
     try:
@@ -384,6 +422,7 @@ def _prove_same_host(proto):
             seg.unlink()
         except OSError:
             pass
+        _disown_segment(seg)
 
 
 def _answer_same_host(proto, challenge):
@@ -406,11 +445,7 @@ def _answer_same_host(proto, challenge):
         except (OSError, ValueError):
             seg = None
         if seg is not None:
-            try:
-                from multiprocessing import resource_tracker
-                resource_tracker.unregister(seg._name, "shared_memory")
-            except Exception:
-                pass
+            _unregister_foreign(seg)
             try:
                 raw = bytes(seg.buf[:min(n, seg.size)])
                 proof = hmac.new(raw, b"veles-shm-proof",
@@ -440,6 +475,11 @@ class SlaveDescription(object):
         # True while result_sink is merging this slave's update: the
         # reaper must not drop/requeue mid-merge (double training)
         self.applying = False
+        # clean-exit markers: the server replied done=True, or the
+        # client announced a voluntary exit ({"cmd": "bye"}) — a
+        # connection dying WITHOUT either mid-run is a crash
+        self.done_sent = False
+        self.said_bye = False
 
     @property
     def current_job(self):
@@ -456,14 +496,33 @@ class CoordinatorServer(Logger):
 
     MAX_IN_FLIGHT = 2
 
+    #: overrun floor for a slave's FIRST jobs: they absorb its XLA
+    #: compile (segment shapes it has never seen — e.g. the varied
+    #: batch counts a mid-epoch resume replays), which the adaptive
+    #: mean+3σ of the WARM fleet's job history knows nothing about.
+    #: Without the floor, a master restarted onto a warm history drops
+    #: every rejoining slave mid-first-compile and the fleet churns.
+    WARMUP_JOBS = 2
+    WARMUP_TIMEOUT = 180.0
+
     def __init__(self, address=("127.0.0.1", 0), checksum="",
                  job_timeout=None, heartbeat_timeout=10.0,
                  job_source=None, result_sink=None, on_drop=None,
                  initial_data_source=None, secret=None, max_frame=None,
-                 on_slave_flight=None):
+                 on_slave_flight=None, straggler_drop_s=None):
         super(CoordinatorServer, self).__init__()
         self.checksum = checksum
         self.max_frame = max_frame
+        #: reaction layer on the PR 9 detection substrate: a slave the
+        #: HealthScorer has held in ``straggler`` state for this many
+        #: seconds is dropped and its in-flight jobs requeued to the
+        #: healthy fleet (None = detect-and-alert only). The dropped
+        #: slave's connection closes on its NEXT request ({"error":
+        #: "dropped"}), after which it may rejoin immediately through
+        #: the elastic-join path with a clean health slate — pair the
+        #: grace with detection long enough that a still-slow
+        #: rejoiner is re-flagged rather than flapping the fleet.
+        self.straggler_drop_s = straggler_drop_s
         #: shared secret: when set, every connection (jobs AND
         #: heartbeats) must complete a mutual HMAC challenge before any
         #: payload is accepted — the role of nothing in the reference,
@@ -507,6 +566,25 @@ class CoordinatorServer(Logger):
             labels=("slave",))
         self._m_drops = registry.counter(
             "veles_slave_drops_total", "Slaves dropped (death/timeout)")
+        #: the recovery plane's own series (ISSUE 12): how many jobs
+        #: membership churn forced back onto the queue, how many
+        #: slaves (re)joined, and how long the fleet took to make
+        #: progress again after a fault
+        self._m_requeued = registry.counter(
+            "veles_jobs_requeued_total",
+            "In-flight jobs requeued after a slave was dropped",
+            labels=("reason",))
+        self._m_joins = registry.counter(
+            "veles_slave_joins_total",
+            "Successful slave handshakes", labels=("kind",))
+        self._m_recovery_ms = registry.histogram(
+            "veles_recovery_ms",
+            "Fault detection to training progress resumed",
+            labels=("event",))
+        #: wall time of the oldest unrecovered requeue (the next
+        #: resolved result closes it into veles_recovery_ms)
+        self._recovery_mark = None
+        self._jobs_handed = False
         self._m_hb_handler_ms = registry.histogram(
             "veles_heartbeat_handler_ms",
             "Master time absorbing one heartbeat's telemetry piggyback")
@@ -529,7 +607,7 @@ class CoordinatorServer(Logger):
         self._lock = threading.Lock()
         self._results_cv = threading.Condition(self._lock)
         self._done = threading.Event()
-        self._listener = socket.create_server(address)
+        self._listener = self._bind_listener(address)
         self.address = self._listener.getsockname()
         self._threads = []
         self._accepting = True
@@ -543,6 +621,26 @@ class CoordinatorServer(Logger):
                              name="coordinator-reaper")
         r.start()
         self._threads.append(r)
+
+    @staticmethod
+    def _bind_listener(address, retry_s=5.0):
+        """Bind, riding out a transient EADDRINUSE on an EXPLICIT
+        port: a master restarted onto its advertised address races
+        its predecessor's dying sockets for a moment (auto-resume,
+        ISSUE 12). A random port (0) never conflicts and a port held
+        by a genuinely different service still fails within
+        ``retry_s``."""
+        import errno
+        address = tuple(address)
+        deadline = time.monotonic() + retry_s
+        while True:
+            try:
+                return socket.create_server(address)
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or not address[1] or \
+                        time.monotonic() >= deadline:
+                    raise
+            time.sleep(0.25)
 
     def _reap_loop(self):
         while not self._done.wait(min(self.heartbeat_timeout / 4, 1.0)):
@@ -594,7 +692,9 @@ class CoordinatorServer(Logger):
         return self.job_timeout
 
     def _reap_dead(self):
-        """Requeue jobs of slaves that stopped heartbeating/overran."""
+        """Requeue jobs of slaves that stopped heartbeating/overran,
+        plus (with ``straggler_drop_s``) slaves the health scorer has
+        held in ``straggler`` state past the grace window."""
         now = time.time()
         timeout = self._adaptive_timeout()
         for sid, slave in list(self.slaves.items()):
@@ -603,37 +703,52 @@ class CoordinatorServer(Logger):
                 # drop now would requeue a minibatch that IS trained
                 continue
             dead = now - slave.last_seen > self.heartbeat_timeout
-            overrun = (timeout is not None and slave.current_job and
-                       now - slave.current_job[1] > timeout)
+            slave_timeout = timeout
+            if slave_timeout is not None and \
+                    slave.jobs_done < self.WARMUP_JOBS:
+                slave_timeout = max(slave_timeout, self.WARMUP_TIMEOUT)
+            overrun = (slave_timeout is not None and slave.current_job and
+                       now - slave.current_job[1] > slave_timeout)
             if dead or overrun:
-                self.warning("dropping slave %s (%s)", sid,
-                             "dead" if dead else "job timeout")
-                # counted HERE, not in drop_slave: the connection
-                # handler also calls drop_slave on a clean end-of-run
-                # disconnect, which is not a death/timeout
-                self._m_drops.inc()
-                # a DEAD slave's labeled series go too (clean
-                # disconnects keep theirs — end-of-run snapshots still
-                # want them): a churny run replacing slaves for hours
-                # must not grow {slave=...} cardinality without bound
-                for family in (self._m_rtt_ms, self._m_job_ms,
-                               self._m_source_ms, self._m_sink_ms,
-                               self._m_jobs, self._m_flight_notices):
-                    family.remove(slave=sid)
-                # the launcher-owned exchange families are slave-
-                # labeled too; reach them by name (a static-farming
-                # server without a launcher simply has none)
-                registry = get_registry()
-                for name in ("veles_exchange_bytes_total",
-                             "veles_exchange_encode_ms",
-                             "veles_exchange_decode_ms"):
-                    family = registry.get(name)
-                    if family is not None and \
-                            "slave" in family.label_names:
-                        family.remove(slave=sid)
-                self.drop_slave(sid)
+                self._drop_faulted(sid, "dead" if dead else "timeout")
+        if self.straggler_drop_s is None:
+            return
+        for sid, row in self.health.table().items():
+            slave = self.slaves.get(sid)
+            if slave is None or slave.applying:
+                continue
+            if row["state"] == "straggler" and \
+                    row["state_age_s"] >= self.straggler_drop_s:
+                self._drop_faulted(sid, "straggler")
 
-    def drop_slave(self, sid):
+    def _drop_faulted(self, sid, reason):
+        """Drop a FAULTED slave (dead/timeout/straggler): counted as a
+        drop, its labeled series GC'd, its jobs requeued under
+        ``reason``. Clean end-of-run disconnects never come through
+        here — they keep their series for the final snapshot."""
+        self.warning("dropping slave %s (%s)", sid, reason)
+        self._m_drops.inc()
+        # a FAULTED slave's labeled series go too (clean disconnects
+        # keep theirs — end-of-run snapshots still want them): a
+        # churny run replacing slaves for hours must not grow
+        # {slave=...} cardinality without bound
+        for family in (self._m_rtt_ms, self._m_job_ms,
+                       self._m_source_ms, self._m_sink_ms,
+                       self._m_jobs, self._m_flight_notices):
+            family.remove(slave=sid)
+        # the launcher-owned exchange families are slave-labeled too;
+        # reach them by name (a static-farming server without a
+        # launcher simply has none)
+        registry = get_registry()
+        for name in ("veles_exchange_bytes_total",
+                     "veles_exchange_encode_ms",
+                     "veles_exchange_decode_ms"):
+            family = registry.get(name)
+            if family is not None and "slave" in family.label_names:
+                family.remove(slave=sid)
+        self.drop_slave(sid, reason=reason)
+
+    def drop_slave(self, sid, reason="disconnect"):
         slave = self.slaves.pop(sid, None)
         if slave is not None:
             # the federated feed and health row describe a LIVE slave:
@@ -641,6 +756,13 @@ class CoordinatorServer(Logger):
             self.federation.remove_slave(sid)
             self.health.remove(sid)
             if slave.jobs_in_flight:
+                self._m_requeued.labels(reason=reason).inc(
+                    len(slave.jobs_in_flight))
+                if self._recovery_mark is None:
+                    # closed by the next resolved result: the time the
+                    # epoch could not make progress because of this
+                    # fault (veles_recovery_ms{event="requeue"})
+                    self._recovery_mark = time.time()
                 if self.on_drop is None:
                     # static job farming: requeue the raw payloads
                     # (oldest first keeps the original order)
@@ -743,6 +865,11 @@ class CoordinatorServer(Logger):
             if self.initial_data_source is not None:
                 reply["data"] = self.initial_data_source(slave_desc)
             proto.send(reply)
+            # a join after the first job was handed out is an ELASTIC
+            # join: the slave entered a run already in progress (and,
+            # via initial_data, received the full-push resync)
+            self._m_joins.labels(
+                kind="mid_run" if self._jobs_handed else "initial").inc()
             if sharedio:
                 # only AFTER the handshake reply is on the wire: the
                 # client enables its rx side when it parses that reply,
@@ -761,7 +888,21 @@ class CoordinatorServer(Logger):
         finally:
             if sid is not None:
                 with self._lock:
-                    self.drop_slave(sid)
+                    slave = self.slaves.get(sid)
+                    if slave is not None and not slave.said_bye and \
+                            not slave.done_sent and \
+                            not self._done.is_set():
+                        # the connection died mid-run with neither a
+                        # goodbye nor a done reply: that is a crash
+                        # (SIGKILL'd slave's kernel-closed socket —
+                        # the common death, far faster than the
+                        # heartbeat reaper; also covers a kill landing
+                        # on an IDLE instant), not a clean end-of-run
+                        # exit — count it as a death so slave_dead
+                        # fires and the series GC runs
+                        self._drop_faulted(sid, "dead")
+                    else:
+                        self.drop_slave(sid)
             proto.close()
 
     def _handle(self, sid, msg):
@@ -791,10 +932,13 @@ class CoordinatorServer(Logger):
                     payload = self.jobs.pop(0)
                     slave.jobs_in_flight.append((payload, time.time()))
                     slave.state = "WORK"
+                    self._jobs_handed = True
                     return self._job_reply(payload), False
                 if self.job_source is None or self.no_more_jobs:
                     if not slave.jobs_in_flight:
                         slave.state = "IDLE"
+                    if self.no_more_jobs:
+                        slave.done_sent = True
                     return {"job": None, "done": self.no_more_jobs}, False
                 action = "source"
             elif cmd == "result":
@@ -816,6 +960,12 @@ class CoordinatorServer(Logger):
                                                    time.time())
                 slave.jobs_done += 1
                 self._m_jobs.labels(slave=sid).inc()
+                if self._recovery_mark is not None:
+                    # first resolved result since a fault requeued
+                    # jobs: training is making progress again
+                    self._m_recovery_ms.labels(event="requeue").observe(
+                        (time.time() - self._recovery_mark) * 1e3)
+                    self._recovery_mark = None
                 if not slave.jobs_in_flight:
                     slave.state = "WAIT"
                 if self.result_sink is None:
@@ -828,6 +978,12 @@ class CoordinatorServer(Logger):
                 slave.power = msg.get("power", slave.power)
                 self._record_rtt(sid, msg)
                 action = "heartbeat"
+            elif cmd == "bye":
+                # voluntary exit (max_idle, client shutdown): without
+                # this goodbye a slave dying IDLE mid-run would be
+                # indistinguishable from one exiting on purpose
+                slave.said_bye = True
+                return {"ok": True}, True
             else:
                 return {"error": "unknown cmd %r" % cmd}, False
 
@@ -862,9 +1018,12 @@ class CoordinatorServer(Logger):
                 if payload is not None:
                     slave.jobs_in_flight.append((payload, time.time()))
                     slave.state = "WORK"
+                    self._jobs_handed = True
                     return self._job_reply(payload), False
                 if not slave.jobs_in_flight:
                     slave.state = "IDLE"
+                if self.no_more_jobs:
+                    slave.done_sent = True
                 return {"job": None, "done": self.no_more_jobs}, False
         # action == "sink"
         t0 = time.perf_counter()
@@ -991,10 +1150,38 @@ class CoordinatorClient(Logger):
     def __init__(self, address, checksum="", power=1.0,
                  death_probability=0.0, rand="chaos",
                  heartbeat_interval=2.0, pipeline=True, secret=None,
-                 max_frame=None, federate=None):
+                 max_frame=None, federate=None, reconnect_s=None,
+                 connect_retry_s=None):
         super(CoordinatorClient, self).__init__()
         self.address = tuple(address)
         self.checksum = checksum
+        #: auto-resume support (ISSUE 12): when the master vanishes
+        #: MID-RUN, retry a full re-handshake for up to this many
+        #: seconds (exponential backoff with jitter) instead of giving
+        #: up — the window a restarted master needs to restore from
+        #: its latest snapshot and re-bind. 0/None = die like before.
+        if reconnect_s is None:
+            # `or 0`: an empty-string env var means unset, not float("")
+            reconnect_s = float(
+                os.environ.get("VELES_RECONNECT_S") or 0)
+        self.reconnect_s = reconnect_s
+        #: same budget for the INITIAL connect: a slave started before
+        #: its master must not die on ConnectionRefused
+        if connect_retry_s is None:
+            connect_retry_s = float(
+                os.environ.get("VELES_CONNECT_RETRY_S") or 0)
+        self.connect_retry_s = connect_retry_s
+        #: backoff shape: base * 2^n, each sleep jittered to 50-150%
+        #: so a whole fleet reconnecting to a restarted master does
+        #: not dial in lockstep
+        self.backoff_base_s = float(
+            os.environ.get("VELES_RECONNECT_BASE_S") or 0.25)
+        #: called with this client after every successful MID-RUN
+        #: reconnect (the launcher re-applies the master's initial
+        #: data / resync state through it)
+        self.on_reconnect = None
+        self.reconnects = 0
+        self._closed = False
         self.secret = secret.encode() if isinstance(secret, str) else secret
         self.max_frame = max_frame
         self.power = power
@@ -1053,8 +1240,44 @@ class CoordinatorClient(Logger):
             "sha256").hexdigest()})
         return proto.recv()
 
-    def connect(self):
-        sock = socket.create_connection(self.address, timeout=10.0)
+    def _retry_with_backoff(self, budget_s, attempt_fn):
+        """Run ``attempt_fn`` until it succeeds, retrying socket-level
+        failures with exponential backoff (base * 2^n capped at 10 s,
+        each sleep jittered to 50-150% so a fleet never retries in
+        lockstep) inside a bounded budget. THE retry shape for both
+        the initial dial (:meth:`_dial`) and the mid-run re-handshake
+        (:meth:`reconnect`). Raises :class:`ConnectionError` when the
+        budget is exhausted (or the client was closed)."""
+        import random
+        deadline = time.monotonic() + max(budget_s, 0.0)
+        delay = self.backoff_base_s
+        attempt = 0
+        while True:
+            try:
+                return attempt_fn()
+            except (ConnectionError, OSError) as e:
+                attempt += 1
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    raise ConnectionError(
+                        "could not reach master at %s:%d after %d "
+                        "attempt(s): %s" % (self.address[0],
+                                            self.address[1], attempt, e))
+            sleep = min(delay, remaining) * (0.5 + random.random())
+            time.sleep(min(sleep, max(remaining, 0.0)))
+            delay = min(delay * 2, 10.0)
+
+    def _dial(self, budget_s):
+        """TCP connect with backoff inside a bounded budget. Only
+        SOCKET-level failures retry — protocol rejections (checksum,
+        auth) happen after the dial and propagate immediately."""
+        return self._retry_with_backoff(
+            budget_s,
+            lambda: socket.create_connection(self.address, timeout=10.0))
+
+    def connect(self, retry_s=None):
+        sock = self._dial(self.connect_retry_s if retry_s is None
+                          else retry_s)
         self.proto = Protocol(sock, max_frame=self.max_frame)
         nonce = secrets.token_hex(32)
         self.proto.send({"cmd": "handshake", "checksum": self.checksum,
@@ -1087,10 +1310,56 @@ class CoordinatorClient(Logger):
         if self.federate:
             from veles_tpu.telemetry.federation import SnapshotEncoder
             self._snapshot_encoder = SnapshotEncoder()
-        t = threading.Thread(target=self._hb_loop, daemon=True,
+        # the proto is passed BY VALUE into the loop: after a mid-run
+        # reconnect the old thread keeps beating its own (now dead)
+        # channel and exits on its ConnectionError, while the new
+        # thread owns the new channel — two threads must never share
+        # one protocol object
+        t = threading.Thread(target=self._hb_loop,
+                             args=(self._hb_proto,), daemon=True,
                              name="slave-heartbeat-%s" % self.id)
         t.start()
         return self
+
+    def reconnect(self):
+        """Full re-handshake after the master vanished mid-run: tear
+        down both channels, then redial with backoff for up to
+        ``reconnect_s`` seconds. The restored/restarted master assigns
+        a NEW slave id; jobs lost with the old master are requeued by
+        its recovery plane, never replayed from here. Returns True on
+        success."""
+        if not self.reconnect_s or self._closed:
+            return False
+        self.warning("master at %s:%d lost mid-run; retrying for up "
+                     "to %.0fs", self.address[0], self.address[1],
+                     self.reconnect_s)
+
+        def attempt():
+            for proto in (getattr(self, "proto", None),
+                          getattr(self, "_hb_proto", None)):
+                if proto is not None:
+                    proto.close()
+            # single-shot dial (retry_s=0): the WHOLE handshake is the
+            # retried unit, because a dying master can accept the TCP
+            # connect and even answer the main handshake before its
+            # listener closes — the failure can land anywhere in the
+            # sequence, not just the dial
+            self.connect(retry_s=0)
+
+        try:
+            self._retry_with_backoff(self.reconnect_s, attempt)
+        except (ConnectionError, OSError) as e:
+            self.warning("reconnect failed: %s", e)
+            return False
+        self.reconnects += 1
+        self.info("reconnected to master as slave %s", self.id)
+        if self.on_reconnect is not None:
+            try:
+                self.on_reconnect(self)
+            except Exception:
+                self.warning("on_reconnect callback failed",
+                             exc_info=True)
+        return True
 
     def notify_flight(self, reason, path=None, context=None):
         """Queue a flight-record notice for the next heartbeat and
@@ -1108,7 +1377,7 @@ class CoordinatorClient(Logger):
         self._flight_notices.append(notice)
         self._hb_wake.set()
 
-    def _hb_loop(self):
+    def _hb_loop(self, proto):
         # each beat reports the round-trip the PREVIOUS beat measured;
         # the master aggregates them per slave (heartbeat RTT series).
         # Since ISSUE 9 a beat also carries the registry snapshot
@@ -1139,10 +1408,13 @@ class CoordinatorClient(Logger):
                 msg["flight"] = notices
             try:
                 t0 = time.perf_counter()
-                self._hb_proto.send(msg)
-                reply = self._hb_proto.recv()
+                proto.send(msg)
+                reply = proto.recv()
                 rtt_ms = (time.perf_counter() - t0) * 1e3
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError, ValueError):
+                # ValueError: close() raced this beat mid-send ("write
+                # to closed file" from the buffered pair) — same
+                # meaning as the connection dropping
                 return
             if isinstance(reply, dict) and reply.get("resync") and \
                     self._snapshot_encoder is not None:
@@ -1177,13 +1449,22 @@ class CoordinatorClient(Logger):
                     self.proto.send({"cmd": "job"})
                     reply = self.proto.recv()
                 except (ConnectionError, OSError):
-                    # master went away: nothing more for this slave
-                    return self.jobs_done
+                    # master went away mid-run: with a reconnect
+                    # budget, re-handshake (a restarted master may be
+                    # restoring from its snapshot right now) and keep
+                    # serving; otherwise nothing more for this slave
+                    if not self.reconnect():
+                        return self.jobs_done
+                    idle = 0
+                    continue
                 if reply.get("job") is None:
                     if reply.get("done"):
                         return self.jobs_done
                     idle += 1
                     if max_idle is not None and idle >= max_idle:
+                        # voluntary exit: say goodbye so the master
+                        # records a clean disconnect, not a death
+                        self._say_goodbye()
                         return self.jobs_done
                     time.sleep(idle_sleep)
                     continue
@@ -1221,10 +1502,18 @@ class CoordinatorClient(Logger):
                                  "trace": job_trace})
                 self.proto.recv()  # result ack
             except (ConnectionError, OSError):
-                # master shut down while we were computing — a normal
-                # end-of-run, not an error (the result is lost, but the
-                # master only closes once it has all it needs)
-                return self.jobs_done
+                # master shut down while we were computing — either a
+                # normal end-of-run (the result is lost, but the
+                # master only closes once it has all it needs) or a
+                # crash: with a reconnect budget, rejoin — the result
+                # is discarded, the restored master requeues the job
+                # itself (exactly-once stays with the master's
+                # accounting, never with a stale slave-side replay)
+                if not self.reconnect():
+                    return self.jobs_done
+                pending_job = None
+                idle = 0
+                continue
             self.jobs_done += 1
             if prefetched:
                 nxt = next_reply.get("job")
@@ -1237,9 +1526,28 @@ class CoordinatorClient(Logger):
         self.proto.send({"cmd": "heartbeat", "power": self.power})
         self.proto.recv()
 
+    def _say_goodbye(self):
+        """Best-effort voluntary-exit notice ({"cmd": "bye"}): lets
+        the master classify this disconnect as clean instead of a
+        death (which would count a drop and GC the series)."""
+        try:
+            self.proto.send({"cmd": "bye"})
+            self.proto.recv()
+        except Exception:
+            pass  # the master may already be gone; exiting anyway
+
     def close(self):
+        was_closed = self._closed
+        self._closed = True  # no reconnect attempts past this point
         self._hb_stop.set()
         self._hb_wake.set()  # unblock a beat loop mid-wait
+        if not was_closed:
+            # send-only (no recv: a racing serve thread owns the read
+            # side) — tells the master this teardown is deliberate
+            try:
+                self.proto.send({"cmd": "bye"})
+            except Exception:
+                pass
         self.proto.close()
         if hasattr(self, "_hb_proto"):
             self._hb_proto.close()

@@ -138,3 +138,23 @@ def get(key="default"):
             gen = RandomGenerator(key).seed(str(key).encode())
             _generators[key] = gen
         return gen
+
+
+def dump_states():
+    """Every named generator's state as plain picklable data —
+    ``{key: (numpy_state_tuple, jax_counter, seed_value)}``.
+
+    The master ships this in the elastic-join resync (ISSUE 12) so a
+    slave joining mid-run continues the SAME random streams as the
+    fleet instead of restarting them from its seeds; the payload rides
+    the restricted-unpickle wire codec (str/int/ndarray only)."""
+    with _registry_lock:
+        generators = dict(_generators)
+    return {key: gen.save_state() for key, gen in generators.items()}
+
+
+def restore_states(states):
+    """Inverse of :func:`dump_states`: overwrite (or create) each
+    named generator with the shipped state."""
+    for key, saved in (states or {}).items():
+        get(key).restore_state(saved)

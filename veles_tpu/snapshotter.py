@@ -163,50 +163,16 @@ class SnapshotterToFile(SnapshotterBase):
         if root.common.ensemble.get("size", 0):
             member_tag = "_m%d" % root.common.ensemble.get("model_index", 0)
         suffix += member_tag
-        ext = ("." + self.compression) if self.compression else ""
-        name = "%s%s.%d.pickle%s" % (self.prefix, suffix,
-                                     self._wf_epoch(wf), ext)
-        os.makedirs(self.directory, exist_ok=True)
-        path = os.path.join(self.directory, name)
-        payload = dump_workflow(wf)
-        # write to a temp file then rename: a crash mid-write must not
-        # destroy the previous snapshot of the same name
-        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
-        os.close(fd)
-        try:
-            with CODECS.get(self.compression, open)(tmp, "wb") as fout:
-                fout.write(payload)
-            os.replace(tmp, path)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        path, nbytes = save_snapshot(
+            wf, self.directory, tag=suffix, prefix=self.prefix,
+            compression=self.compression, link_tag=member_tag)
         self.destination = path
-        self._update_symlink(path, ext, member_tag)
         self.info("snapshotted to %s (%.1f MiB)", path,
-                  len(payload) / 1048576.0)
+                  nbytes / 1048576.0)
 
     @staticmethod
     def _wf_epoch(wf):
-        decision = getattr(wf, "decision", None)
-        if decision is not None:
-            return int(getattr(decision, "epoch_number", 0) or 0)
-        loader = getattr(wf, "loader", None)
-        if loader is not None:
-            return int(getattr(loader, "epoch_number", 0) or 0)
-        return 0
-
-    def _update_symlink(self, path, ext="", member_tag=""):
-        # the member tag keeps concurrent ensemble members from racing
-        # over a shared "_current" pointer
-        link_path = os.path.join(
-            self.directory,
-            "%s%s_current.pickle%s" % (self.prefix, member_tag, ext))
-        try:
-            if os.path.islink(link_path) or os.path.exists(link_path):
-                os.unlink(link_path)
-            os.symlink(os.path.basename(path), link_path)
-        except OSError as exc:  # filesystems without symlinks
-            self.debug("could not update %s: %s", link_path, exc)
+        return wf_epoch(wf)
 
     @staticmethod
     def import_(uri):
@@ -303,54 +269,197 @@ class SnapshotterToDB(SnapshotterBase):
         return load_workflow(_maybe_decompress(bytes(row[0])))
 
 
-def latest_snapshot(directory, prefix=None):
-    """Newest snapshot in a :class:`SnapshotterToFile` directory.
+def wf_epoch(wf):
+    """The epoch number a snapshot of ``wf`` is named after."""
+    decision = getattr(wf, "decision", None)
+    if decision is not None:
+        return int(getattr(decision, "epoch_number", 0) or 0)
+    loader = getattr(wf, "loader", None)
+    if loader is not None:
+        return int(getattr(loader, "epoch_number", 0) or 0)
+    return 0
 
-    Prefers the ``*_current.pickle*`` symlink the exporter maintains
-    (resolved to its target); falls back to the most recently modified
-    ``*.pickle*`` file on filesystems without symlinks. The serving
-    model store (``veles_tpu/serving/model_store.py``) points at a
-    snapshot directory and gets the freshest checkpoint."""
-    candidates = []
+
+def save_snapshot(workflow, directory, tag="", prefix="wf",
+                  compression="gz", link_tag="", payload=None):
+    """Atomically write ONE snapshot file and refresh its ``_current``
+    link; returns ``(path, payload_bytes)``. ``payload`` accepts a
+    pre-computed :func:`dump_workflow` blob so a caller can serialize
+    under its own locks and pay the compress+disk cost outside them.
+
+    The shared writer behind :class:`SnapshotterToFile.export` and the
+    master-side auto-snapshot hook (``launcher.py`` — a master's
+    workflow graph never executes, so the Snapshotter *unit* cannot
+    gate there; adding one would also change the topology checksum
+    slaves handshake against). Staging goes through a HIDDEN
+    ``.*.tmp`` file renamed into place, so a crash mid-write leaves
+    only debris that :func:`latest_snapshot` skips."""
+    import logging
+    ext = ("." + compression) if compression else ""
+    name = "%s%s.%d.pickle%s" % (prefix, tag, wf_epoch(workflow), ext)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, name)
+    if payload is None:
+        payload = dump_workflow(workflow)
+    # write to a temp file then rename: a crash mid-write must not
+    # destroy the previous snapshot of the same name
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".", suffix=".tmp")
+    os.close(fd)
+    try:
+        with CODECS.get(compression, open)(tmp, "wb") as fout:
+            fout.write(payload)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    link_path = os.path.join(
+        directory, "%s%s_current.pickle%s" % (prefix, link_tag, ext))
+    # the link_tag (ensemble member id) keeps concurrent members from
+    # racing over a shared "_current" pointer
+    try:
+        if os.path.islink(link_path) or os.path.exists(link_path):
+            os.unlink(link_path)
+        os.symlink(os.path.basename(path), link_path)
+    except OSError as exc:  # filesystems without symlinks
+        logging.getLogger("Snapshotter").debug(
+            "could not update %s: %s", link_path, exc)
+    return path, len(payload)
+
+
+def snapshot_candidates(directory, prefix=None):
+    """Snapshot paths under a :class:`SnapshotterToFile` directory,
+    best-first: the ``_current`` link's resolved target leads, the
+    rest follow newest-mtime-first. In-progress staging files
+    (hidden / ``*.tmp``) are never candidates — a restore racing an
+    export must not pick a half-written artifact."""
+    current = None
+    rest = []
     for name in os.listdir(directory):
+        if name.startswith(".") or name.endswith(".tmp"):
+            continue
         if ".pickle" not in name:
             continue
         if prefix is not None and not name.startswith(prefix):
             continue
         path = os.path.join(directory, name)
         if "_current.pickle" in name:
-            return os.path.realpath(path)
-        candidates.append(path)
+            resolved = os.path.realpath(path)
+            if os.path.exists(resolved):
+                current = resolved
+        else:
+            rest.append(path)
+    rest.sort(key=os.path.getmtime, reverse=True)
+    if current is not None:
+        rest = [p for p in rest if os.path.realpath(p) != current]
+        return [current] + rest
+    return rest
+
+
+def latest_snapshot(directory, prefix=None):
+    """Newest snapshot in a :class:`SnapshotterToFile` directory.
+
+    Prefers the ``*_current.pickle*`` symlink the exporter maintains
+    (resolved to its target); falls back to the most recently modified
+    ``*.pickle*`` file on filesystems without symlinks; skips
+    in-progress ``.tmp`` staging files. The serving model store
+    (``veles_tpu/serving/model_store.py``) points at a snapshot
+    directory and gets the freshest checkpoint."""
+    candidates = snapshot_candidates(directory, prefix)
     if not candidates:
         raise FileNotFoundError(
             "no snapshots under %s%s" %
             (directory, " with prefix %r" % prefix if prefix else ""))
-    return max(candidates, key=os.path.getmtime)
+    return candidates[0]
+
+
+def restore_latest(directory, prefix=None):
+    """Load the newest LOADABLE snapshot: ``(workflow, path)``.
+
+    A truncated or corrupt newest artifact (crash mid-copy, torn
+    rsync, disk-full tail) falls back to the previous snapshot with a
+    warning instead of crashing the resume — the auto-resume path
+    (``Launcher(auto_resume=dir)``) must come back up with the best
+    state that actually loads."""
+    import logging
+    log = logging.getLogger("Snapshotter")
+    candidates = snapshot_candidates(directory, prefix)
+    if not candidates:
+        raise FileNotFoundError(
+            "no snapshots under %s%s" %
+            (directory, " with prefix %r" % prefix if prefix else ""))
+    last_error = None
+    for path in candidates:
+        try:
+            return load_workflow(path), path
+        except Exception as e:  # noqa: BLE001 — any load failure
+            last_error = e
+            log.warning("snapshot %s is unloadable (%s: %s); falling "
+                        "back to the previous artifact", path,
+                        type(e).__name__, e)
+    raise FileNotFoundError(
+        "no loadable snapshot under %s (%d candidate(s), last error: "
+        "%s)" % (directory, len(candidates), last_error))
+
+
+class _LauncherCuttingPickler(pickle.Pickler):
+    """Pickles a workflow WITHOUT its launcher: the launcher object is
+    replaced by a persistent id (restored as ``None``). This replaces
+    the old ``workflow._workflow = None``-around-dump dance, which
+    mutated shared state — the master-side auto-snapshot hook
+    (ISSUE 12) dumps while OTHER threads merge slave updates, and
+    those threads' ``is_master`` checks must not go blind mid-dump."""
+
+    def __init__(self, fileobj, launcher):
+        super(_LauncherCuttingPickler, self).__init__(
+            fileobj, protocol=pickle.HIGHEST_PROTOCOL)
+        self._launcher = launcher
+
+    def persistent_id(self, obj):
+        if self._launcher is not None and obj is self._launcher:
+            return "veles-launcher"
+        return None
+
+
+class _SnapshotUnpickler(pickle.Unpickler):
+    def persistent_load(self, pid):
+        return None  # the restored workflow re-binds to a new launcher
 
 
 def dump_workflow(workflow):
-    """Serialize a workflow to bytes (header + graph + PRNG registry)."""
-    launcher = workflow._workflow
-    workflow._workflow = None  # the launcher is never part of a snapshot
-    try:
-        blob = {
-            "format": 1,
-            "checksum": workflow.checksum,
-            "random": dict(prng._generators),
-            "workflow": workflow,
-        }
-        return pickle.dumps(blob, protocol=pickle.HIGHEST_PROTOCOL)
-    finally:
-        workflow._workflow = launcher
+    """Serialize a workflow to bytes (header + graph + PRNG registry).
+
+    Thread-safe w.r.t. concurrent unit execution/merges: nothing on
+    the workflow is mutated (see :class:`_LauncherCuttingPickler`)."""
+    import io
+    blob = {
+        "format": 1,
+        "checksum": workflow.checksum,
+        "random": dict(prng._generators),
+        "workflow": workflow,
+    }
+    buf = io.BytesIO()
+    _LauncherCuttingPickler(buf, workflow._workflow).dump(blob)
+    return buf.getvalue()
+
+
+def _loads_snapshot(payload):
+    import io
+    return _SnapshotUnpickler(io.BytesIO(payload)).load()
 
 
 def load_workflow(path_or_bytes):
     """Inverse of :func:`dump_workflow`; accepts a path or raw bytes."""
     if isinstance(path_or_bytes, bytes):
-        blob = pickle.loads(path_or_bytes)
+        blob = _loads_snapshot(path_or_bytes)
     else:
         with _open_for_read(path_or_bytes) as fin:
-            blob = pickle.loads(fin.read())
+            blob = _loads_snapshot(fin.read())
+    if not isinstance(blob, dict) or "workflow" not in blob:
+        # a pickle that loads but is not a snapshot (somebody pointed
+        # a restore at an arbitrary .pickle) must fail integrity here,
+        # not explode attribute-by-attribute later
+        raise pickle.UnpicklingError(
+            "not a veles snapshot stream (missing workflow header)")
     for key, gen in blob.get("random", {}).items():
         prng._generators[key] = gen
     workflow = blob["workflow"]

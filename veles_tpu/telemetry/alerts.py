@@ -199,6 +199,11 @@ DEFAULT_RULES = (
      "agg": "max", "op": ">=", "threshold": 1.0, "for_s": 0.0,
      "clear_for_s": 2.0,
      "description": "a slave is flagged straggler by the health scorer"},
+    {"name": "slave_dead", "kind": "increase",
+     "metric": "veles_slave_drops_total", "window_s": 300.0,
+     "threshold": 0.0, "clear_for_s": 300.0, "severity": "critical",
+     "description": "a slave was dropped (death/timeout/straggler) "
+                    "and its jobs requeued in the last 5 minutes"},
 )
 
 

@@ -252,8 +252,58 @@ class Workflow(Container):
         return data
 
     def apply_initial_data_from_master(self, data):
+        # ISSUE 12: a master mid-run wraps the negotiates_on_connect
+        # payload with a full-push RESYNC block ({"units": ...,
+        # "resync": ...}) so an elastically-joining slave starts from
+        # the fleet's live state; the bare-list form stays the
+        # start-of-run handshake payload
+        if isinstance(data, dict):
+            resync = data.get("resync")
+            if resync:
+                self.apply_resync_from_master(resync)
+            data = data.get("units")
         for name, payload in data or []:
             self[name].apply_data_from_master(payload)
+
+    # -- elastic join: full-push resync (ISSUE 12) -------------------------
+
+    def generate_resync_for_slave(self, slave=None):
+        """Everything a slave joining MID-RUN needs to behave exactly
+        like a resident slave from its first job: the current weights
+        and decision state (every non-loader unit's slave payload),
+        the epoch/offset cursors, and the PRNG registry state — so
+        its streams continue the fleet's, not restart from seeds.
+
+        Read-only by construction: the loader is EXCLUDED because its
+        ``generate_data_for_slave`` advances the serving cursor; its
+        cursors ship as plain numbers instead."""
+        from veles_tpu import prng
+        loader = getattr(self, "loader", None)
+        units = [(u.name, u.generate_data_for_slave_locked(slave))
+                 for u in self._distributed_units()
+                 if u is not loader and not u.negotiates_on_connect]
+        resync = {"units": units, "random": prng.dump_states()}
+        if loader is not None:
+            resync["epoch"] = int(loader.epoch_number)
+            resync["served"] = int(loader.samples_served)
+        return resync
+
+    def apply_resync_from_master(self, resync):
+        """Slave side of :meth:`generate_resync_for_slave`."""
+        from veles_tpu import prng
+        prng.restore_states(resync.get("random"))
+        for name, payload in resync.get("units") or []:
+            if payload is None:
+                continue
+            try:
+                self[name].apply_data_from_master(payload)
+            except KeyError:
+                self.warning("resync names unknown unit %r; skipped",
+                             name)
+        loader = getattr(self, "loader", None)
+        if loader is not None and "epoch" in resync:
+            loader.epoch_number = int(resync["epoch"])
+            loader.samples_served = int(resync.get("served", 0))
 
     def generate_data_for_slave(self, slave=None):
         """Collect one job: per-unit payloads (``workflow.py:476-511``).
