@@ -460,19 +460,24 @@ def run_shmbench():
 LEG_TIMEOUT = float(os.environ.get("VELES_DIST_TIMEOUT", 1800))
 
 
-def _spawn(mode, *args, tpu, extra_env=None, tag=None):
+def _spawn(mode, *args, tpu, extra_env=None, tag=None, argv=None):
     """Start a leg subprocess with BACKGROUND pipe pumps: stderr lines
     are forwarded (tagged) as they arrive and stdout lines collected —
     so a slave producing >64 KB of output can never fill its pipe and
-    deadlock the harness against a blocked master."""
+    deadlock the harness against a blocked master. ``argv`` overrides
+    the default ``python bench_distributed.py <mode>`` command (the
+    spmd-kill leg launches elastic supervisors through the SAME pump
+    machinery — EVENT lines land in ``proc.events`` either way)."""
     env = dict(os.environ)
     if not tpu:
         env["JAX_PLATFORMS"] = "cpu"
         env["VELES_TPU_BACKEND"] = "cpu"
     env.update(extra_env or {})
-    proc = subprocess.Popen(
+    cmd = list(argv) if argv is not None else (
         [sys.executable, os.path.abspath(__file__), mode] +
-        [str(a) for a in args],
+        [str(a) for a in args])
+    proc = subprocess.Popen(
+        cmd,
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True)
     proc.tag = tag or mode
@@ -808,6 +813,139 @@ def orchestrate_chaos_master_restart():
         shutil.rmtree(snapdir, ignore_errors=True)
 
 
+def orchestrate_chaos_spmd_kill():
+    """``--chaos spmd-kill`` (ISSUE 13): the SPMD-mesh analog of
+    ``--chaos kill``. A rendezvous anchor plus TWO supervised
+    ``jax.distributed`` DP worker processes (4 virtual CPU devices
+    each, one 8-way data mesh) train the demo config with per-epoch
+    sharded checkpoints; once the first epoch's generation commits,
+    rank 1's SUPERVISOR and worker are both SIGKILLed (a whole-host
+    loss — detection is the kernel-closed rendezvous socket). The
+    surviving supervisor must kill its wedged worker, re-form the
+    mesh at world size 1, restore the last complete generation and
+    finish EVERY epoch; measured are time-to-reform (kill -> new
+    generation running) and the server's break->formed recovery."""
+    import signal
+    import tempfile
+
+    from veles_tpu.parallel.elastic import RendezvousServer
+
+    epochs = int(os.environ.get("VELES_DIST_EPOCHS", 6))
+    workdir = tempfile.mkdtemp(prefix="veles_spmd_chaos_")
+    snaps = os.path.join(workdir, "snaps")
+    outs = [os.path.join(workdir, "h%d.json" % i) for i in range(2)]
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env_base["PYTHONPATH"] = HERE + (
+        os.pathsep + env_base["PYTHONPATH"]
+        if env_base.get("PYTHONPATH") else "")
+    server = RendezvousServer(expected=2, min_workers=1, settle_s=0.5,
+                              heartbeat_timeout_s=3.0).start()
+    addr = "%s:%d" % server.address
+    procs = []
+
+    def worker_pid(proc, gen):
+        for name, kv in proc.events:
+            if name == "spmd_worker" and kv.get("gen") == str(gen):
+                return int(kv["pid"])
+        return None
+
+    try:
+        for i in range(2):
+            cmd = [sys.executable, "-m",
+                   "veles_tpu.parallel.elastic", "supervise",
+                   "--rdzv", addr, "--member", "h%d" % i,
+                   "--snapshots", snaps,
+                   "--max-restarts", "3" if i == 0 else "0",
+                   "--worker-env", "JAX_PLATFORMS=cpu",
+                   "--worker-env",
+                   "XLA_FLAGS=--xla_force_host_platform_device_count=4",
+                   "--", sys.executable, "-m",
+                   "veles_tpu.parallel.elastic", "worker-demo",
+                   "--out", outs[i], "--epochs", str(epochs),
+                   "--epoch-sleep", "0.5"]
+            procs.append(_spawn("supervise", tpu=False,
+                                extra_env=env_base,
+                                tag="sup%d" % i, argv=cmd))
+        # wait for the first post-epoch generation to COMMIT, so the
+        # kill provably lands mid-run with a restorable checkpoint
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            done = [d for d in (os.listdir(snaps)
+                                if os.path.isdir(snaps) else [])
+                    if d.endswith(".shards") and
+                    int(d.split(".")[-2]) >= 1 and
+                    os.path.exists(os.path.join(snaps, d,
+                                                "MANIFEST.json"))]
+            if done:
+                break
+            if any(p.poll() is not None for p in procs):
+                raise SystemExit("a supervisor died before the first "
+                                 "checkpoint committed")
+            time.sleep(0.1)
+        else:
+            raise SystemExit("no epoch-1 checkpoint within 600s")
+        victim_worker = worker_pid(procs[1], 0)
+        t_kill = time.time()
+        os.kill(procs[1].pid, signal.SIGKILL)  # the "host" dies...
+        if victim_worker:
+            try:  # ...taking its worker process group with it
+                os.killpg(victim_worker, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        procs[1].wait()
+        print("EVENT spmd_kill t=%.6f" % t_kill, file=sys.stderr,
+              flush=True)
+        # survivor re-forms at world size 1
+        while time.time() < deadline and not (
+                server.generation >= 1 and server.phase in
+                ("running", "done")):
+            time.sleep(0.05)
+        t_reform = time.time()
+        rc0 = procs[0].wait(timeout=600)
+        total_s = time.time() - t_kill
+        history = json.load(open(outs[0]))
+        report = {"mode": "chaos_spmd_kill", "epochs": epochs,
+                  "time_to_reform_s": round(t_reform - t_kill, 3),
+                  "reform_recovery_s":
+                      round(server.last_recovery_s or -1, 3),
+                  "kill_to_completion_s": round(total_s, 3),
+                  "epochs_completed": len(history),
+                  "world_after": server.world_size,
+                  "participants_lost": server.lost_total,
+                  "survivor_rc": rc0}
+        print(json.dumps(report))
+        if rc0 != 0:
+            raise SystemExit("surviving supervisor exited rc=%d" % rc0)
+        if len(history) != epochs:
+            raise SystemExit(
+                "spmd kill run completed %d/%d epochs — the recovery "
+                "plane lost work" % (len(history), epochs))
+        if server.world_size != 1 or server.lost_total < 1:
+            raise SystemExit("mesh did not re-form at world size 1")
+        print("chaos spmd-kill leg PASSED: re-formed at world 1 in "
+              "%.2fs (server break->formed %.2fs), %d/%d epochs after "
+              "restore" % (t_reform - t_kill,
+                           server.last_recovery_s or -1,
+                           len(history), epochs), file=sys.stderr)
+    finally:
+        server.stop()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        # orphaned workers die with their process groups
+        for proc in procs:
+            for gen in range(0, 8):
+                pid = worker_pid(proc, gen)
+                if pid:
+                    try:
+                        os.killpg(pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError,
+                            OSError):
+                        pass
+
+
 def orchestrate_chip():
     env = {"VELES_DIST_CONFIG": CONFIG}
     alone = _drain(_spawn("standalone", tpu=True, extra_env=env),
@@ -843,6 +981,8 @@ def main():
             orchestrate_chaos_kill()
         elif kind == "master-restart":
             orchestrate_chaos_master_restart()
+        elif kind == "spmd-kill":
+            orchestrate_chaos_spmd_kill()
         else:
             raise SystemExit("unknown chaos kind %r" % kind)
     elif sys.argv[1] == "standalone":

@@ -233,6 +233,61 @@ def _recovery_probe():
     return {"recovery_time_s": recovery_s}
 
 
+def _spmd_recovery_probe():
+    """ISSUE 13 recovery-time guard (report-only): the elastic SPMD
+    supervision tier with jax-free stub workers — rendezvous anchor +
+    two supervisors; one worker is SIGKILLed; measured is the server's
+    break -> new-generation-formed time at world size 1 (detection +
+    settle + re-rendezvous — the pure orchestration cost; checkpoint
+    restore and XLA recompile ride on top in a real pod and are
+    covered by `bench_distributed.py --chaos spmd-kill`). Report-only
+    for the same reason as recovery_time_s: shared CI wall clocks are
+    noisy; the structural assertions live in tests/test_elastic.py."""
+    import signal
+    import threading
+
+    from veles_tpu.parallel.elastic import (ElasticSupervisor,
+                                            RendezvousServer)
+
+    server = RendezvousServer(expected=2, min_workers=1, settle_s=0.3,
+                              heartbeat_timeout_s=2.0).start()
+    stub = ("import os, time\n"
+            "if os.environ.get('VELES_ELASTIC_GEN') == '0':\n"
+            "    time.sleep(60)\n")
+    argv = [sys.executable, "-c", stub]
+    addr = "%s:%d" % server.address
+    sups = [ElasticSupervisor(addr, argv, member="p%d" % i,
+                              max_restarts=0, poll_s=0.05)
+            for i in range(2)]
+    rcs = [None, None]
+    threads = [threading.Thread(target=lambda i=i: rcs.__setitem__(
+        i, sups[i].run()), daemon=True) for i in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not (
+                server.phase == "running" and
+                all(s.worker is not None for s in sups)):
+            time.sleep(0.02)
+        if server.phase != "running" or sups[1].worker is None:
+            raise RuntimeError(
+                "spmd recovery probe: generation 0 did not form "
+                "(phase=%s)" % server.phase)
+        time.sleep(0.1)
+        os.kill(sups[1].worker.pid, signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=30)
+        recovery = server.last_recovery_s
+    finally:
+        for sup in sups:
+            sup._kill_worker()
+        server.stop()
+    if rcs[0] != 0 or recovery is None:
+        raise RuntimeError("spmd recovery probe failed: rcs=%r" % rcs)
+    return {"spmd_recovery_time_s": recovery}
+
+
 def capture():
     """Run the probe and return the snapshot dict."""
     from veles_tpu.telemetry import profiler
@@ -266,6 +321,7 @@ def capture():
     metrics.update(_input_pipeline_probe())
     metrics.update(_federation_probe())
     metrics.update(_recovery_probe())
+    metrics.update(_spmd_recovery_probe())
     return {"schema": "veles-perf-snapshot/1",
             "probe": {"samples": SAMPLES, "batch": BATCH,
                       "epochs": EPOCHS, "seed": SEED},

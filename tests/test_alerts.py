@@ -313,3 +313,24 @@ def test_remove_gcs_gauges():
     assert scorer.table() == {}
     assert reg.get("veles_slave_health_state").series() == []
     assert reg.get("veles_slave_health_score").series() == []
+
+
+def test_spmd_participant_lost_rule_fires_on_counter(monkeypatch):
+    """The ISSUE 13 default rule: losing an SPMD participant (the
+    elastic supervisor's counter) raises a critical alert."""
+    from veles_tpu.telemetry.alerts import DEFAULT_RULES
+    spec = next(r for r in DEFAULT_RULES
+                if r["name"] == "spmd_participant_lost")
+    assert spec["severity"] == "critical"
+    reg = MetricsRegistry()
+    lost = reg.counter("veles_spmd_participants_lost_total",
+                       labels=("reason",))
+    lost.labels(reason="connection_lost").inc(0)
+    engine = _engine(reg, spec)
+    t = 1000.0
+    for i in range(0, 400, 30):          # build window-deep history
+        engine.evaluate(now=t + i)
+    assert engine.active() == []
+    lost.labels(reason="connection_lost").inc()
+    engine.evaluate(now=t + 400)
+    assert engine.active() == ["spmd_participant_lost"]

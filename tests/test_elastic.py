@@ -1,0 +1,396 @@
+"""Elastic SPMD recovery plane (ISSUE 13).
+
+The headline proof mirrors PR 12's coordinator-tier invariant at the
+SPMD mesh tier: SIGKILL one of two ``jax.distributed`` DP worker
+processes mid-epoch, the surviving supervisor re-forms the mesh at
+world size 1 from the last COMPLETE sharded checkpoint, and the final
+loss curve is **bit-identical** to an uninterrupted run — the
+deterministic rewind replays the never-checkpointed epoch so every
+minibatch still trains exactly once.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy
+import pytest
+
+from veles_tpu import snapshotter
+from veles_tpu.parallel.elastic import (ElasticSupervisor,
+                                        RendezvousClient,
+                                        RendezvousServer)
+from veles_tpu.parallel.mesh import named_sharding, put_global
+from veles_tpu.parallel.retry import retry_with_backoff
+from veles_tpu.parallel import build_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- the shared backoff helper ----------------------------------------------
+
+
+def test_retry_with_backoff_retries_then_succeeds():
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert retry_with_backoff(attempt, 10.0, base_s=0.001) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_with_backoff_give_up_aborts_immediately():
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        raise ConnectionError("fatal")
+
+    with pytest.raises(ConnectionError, match="dial x after 1"):
+        retry_with_backoff(attempt, 10.0, base_s=0.001,
+                          give_up=lambda e: True, describe="dial x")
+    assert len(calls) == 1
+
+
+# -- init_multihost idempotence / teardown (satellite) ----------------------
+
+
+def test_init_multihost_idempotence_and_shutdown(monkeypatch):
+    from veles_tpu.parallel import mesh as mesh_mod
+    calls = []
+    monkeypatch.setattr(mesh_mod, "_runtime_initialized", lambda: False)
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda coordinator_address, num_processes, process_id:
+        calls.append((coordinator_address, num_processes, process_id)))
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+    monkeypatch.setitem(mesh_mod._MULTIHOST, "spec", None)
+    try:
+        assert mesh_mod.init_multihost(num_processes=1) is False
+        assert mesh_mod.init_multihost("h:1", 2, 0) is True
+        assert len(calls) == 1
+        # same spec: no second runtime init
+        assert mesh_mod.init_multihost("h:1", 2, 0) is True
+        assert len(calls) == 1
+        # different membership needs a fresh process
+        with pytest.raises(RuntimeError, match="fresh process"):
+            mesh_mod.init_multihost("h:1", 3, 0)
+        assert mesh_mod.shutdown_multihost() is True
+        assert mesh_mod.init_multihost("h:2", 2, 1) is True
+        assert len(calls) == 2
+    finally:
+        mesh_mod._MULTIHOST["spec"] = None
+
+
+# -- rendezvous state machine -----------------------------------------------
+
+
+def test_rendezvous_forms_breaks_and_reforms():
+    server = RendezvousServer(expected=2, min_workers=1, settle_s=0.2,
+                              heartbeat_timeout_s=5.0).start()
+    addr = "%s:%d" % server.address
+    a = RendezvousClient(addr, "a")
+    b = RendezvousClient(addr, "b")
+    try:
+        # generation 0 waits for the full expected pod
+        assert a._request({"cmd": "join"})["status"] == "wait"
+        ra = b.join_wait(timeout_s=5)
+        rb = a.join_wait(timeout_s=5)
+        assert ra["gen"] == rb["gen"] == 0
+        assert ra["world"] == rb["world"] == 2
+        assert {ra["rank"], rb["rank"]} == {0, 1}
+        # rank 0 publishes the generation's jax coordinator
+        a.set_coord(0, "127.0.0.1:5555")
+        assert b.get_coord_wait(0) == "127.0.0.1:5555"
+        assert a.heartbeat(0) == "ok"
+        # b's worker crashes -> the generation breaks for everyone
+        reply = b.worker_exit(0, 137)
+        assert reply["status"] == "restart"
+        assert not reply.get("stale")  # first report = the root cause
+        # a second report against the broken generation is collateral
+        assert a.worker_exit(0, 1).get("stale") is True
+        assert a.heartbeat(0) == "restart"
+        b.leave()
+        b.close()
+        # the survivor re-forms alone after the settle window
+        r = a.join_wait(timeout_s=10)
+        assert r["gen"] >= 1 and r["world"] == 1 and r["rank"] == 0
+        assert server.lost_total >= 1
+        assert server.last_recovery_s is not None
+        # completion propagates
+        assert a.worker_exit(r["gen"], 0)["status"] == "done"
+        assert a.heartbeat(r["gen"]) == "done"
+    finally:
+        a.close()
+        b.close()
+        server.stop()
+
+
+def test_rendezvous_supervisor_eof_breaks_generation():
+    """A SIGKILLed supervisor never says goodbye: the kernel-closed
+    connection must break the generation (the fast detection path)."""
+    server = RendezvousServer(expected=2, min_workers=1, settle_s=0.2,
+                              heartbeat_timeout_s=30.0).start()
+    addr = "%s:%d" % server.address
+    a = RendezvousClient(addr, "a")
+    b = RendezvousClient(addr, "b")
+    try:
+        a._request({"cmd": "join"})
+        b.join_wait(timeout_s=5)
+        a.join_wait(timeout_s=5)
+        b._teardown()  # abrupt: socket dies, no leave
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and server.phase == "running":
+            time.sleep(0.05)
+        assert server.generation >= 1  # broken, re-forming
+        assert a.heartbeat(0) == "restart"
+    finally:
+        a.close()
+        server.stop()
+
+
+def test_supervisor_cycle_with_stub_workers():
+    """Full supervisor lifecycle without jax: two supervised stub
+    workers; one is SIGKILLed -> its supervisor (crash budget 0)
+    leaves, the survivor's wedged worker is killed and respawned at
+    world size 1, and the respawned stub completes the run."""
+    server = RendezvousServer(expected=2, min_workers=1, settle_s=0.3,
+                              heartbeat_timeout_s=3.0).start()
+    addr = "%s:%d" % server.address
+    stub = ("import os, time\n"
+            "if os.environ.get('VELES_ELASTIC_GEN') == '0':\n"
+            "    time.sleep(120)\n")
+    argv = [sys.executable, "-c", stub]
+    sups = [ElasticSupervisor(addr, argv, member="h%d" % i,
+                              max_restarts=0, poll_s=0.1)
+            for i in range(2)]
+    rcs = [None, None]
+
+    def run(i):
+        rcs[i] = sups[i].run()
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not (
+                server.phase == "running" and
+                all(s.worker is not None for s in sups)):
+            time.sleep(0.05)
+        assert server.phase == "running" and server.world_size == 2
+        time.sleep(0.2)
+        os.kill(sups[1].worker.pid, signal.SIGKILL)
+        for t in threads:
+            t.join(timeout=30)
+        assert rcs == [0, 1]
+        assert server.phase == "done"
+        assert server.generation >= 1 and server.world_size == 1
+        assert server.lost_total >= 1
+        assert 0 < server.last_recovery_s < 10
+    finally:
+        for sup in sups:
+            sup._kill_worker()
+        server.stop()
+
+
+# -- sharded checkpoint re-assembly across world sizes ----------------------
+
+
+def _tiny_wf(seed=42):
+    from veles_tpu import prng
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.mnist import MnistWorkflow
+    from veles_tpu.parallel.elastic import _DemoProvider
+
+    prng.get().seed(seed)
+    prng.get("loader").seed(seed + 1)
+    wf = MnistWorkflow(DummyLauncher(), provider=_DemoProvider(64, 32),
+                       layers=(8,), minibatch_size=16,
+                       learning_rate=0.1, max_epochs=1)
+    wf.initialize(device=Device(backend="cpu"))
+    return wf
+
+
+def test_checkpoint_written_at_world_2_restores_at_world_1(tmp_path):
+    """Acceptance: a leaf sharded over an 8-device data axis, written
+    as TWO per-process part files (the world-size-2 layout), must
+    re-assemble and re-shard onto a 4-device mesh bit-identically."""
+    mesh8 = build_mesh({"data": 8})
+    host = numpy.arange(64 * 3, dtype=numpy.float32).reshape(64, 3)
+    host += 0.25  # non-integers: bit-identity must survive float repr
+    arr = put_global(host, named_sharding(mesh8, "data"))
+    meta, entries = snapshotter.shard_records(arr)
+    assert tuple(meta["shape"]) == (64, 3) and len(entries) == 8
+    wf = _tiny_wf()
+    spec = {"kind": "param", "forward": 0, "name": "weights"}
+    gen = tmp_path / "wf_g0.0.shards"
+    gen.mkdir()
+    # emulate world size 2: processes 0/1 each wrote their 4 shards
+    snapshotter._write_part_file(str(gen), 0, {
+        "format": 1, "part": 0,
+        "records": [{"spec": spec, "shape": meta["shape"],
+                     "dtype": meta["dtype"], "shards": entries[:4]}],
+        "workflow": snapshotter.dump_workflow(wf)})
+    snapshotter._write_part_file(str(gen), 1, {
+        "format": 1, "part": 1,
+        "records": [{"spec": spec, "shape": meta["shape"],
+                     "dtype": meta["dtype"], "shards": entries[4:]}]})
+    snapshotter._write_manifest(str(gen), 2, 0)
+    wf2, path = snapshotter.restore_latest(str(tmp_path))
+    assert path == str(gen)
+    got = wf2.forwards[0].param_arrays()["weights"].mem
+    assert got.dtype == host.dtype
+    assert (got == host).all()
+    # ...and re-sharding at the new world size is bit-faithful too
+    mesh4 = build_mesh({"data": 4}, devices=jax.devices()[:4])
+    replaced = put_global(got, named_sharding(mesh4, "data"))
+    assert (numpy.asarray(replaced) == host).all()
+
+
+def test_dp_trainer_checkpoint_records_roundtrip_bitwise(tmp_path):
+    """Live (params, states) -> sharded generation -> restored unit
+    arrays, all leaves bit-identical (incl. optimizer state)."""
+    from veles_tpu.parallel import DataParallelTrainer
+    wf = _tiny_wf()
+    trainer = DataParallelTrainer(wf, mesh=build_mesh({"data": 8}))
+    params, states = trainer.pull_params()
+    records = trainer.checkpoint_records(params, states)
+    kinds = {r[0]["kind"] for r in records}
+    assert kinds == {"param", "opt"}
+    snapshotter.save_snapshot_sharded(
+        wf, str(tmp_path), records, process_index=0, process_count=1,
+        tag="_g0", link_tag="")
+    wf2, _ = snapshotter.restore_latest(str(tmp_path))
+    for i, fwd in enumerate(wf.forwards):
+        for name in fwd.param_arrays():
+            a = numpy.asarray(params[i][name])
+            b = wf2.forwards[i].param_arrays()[name].mem
+            assert a.dtype == b.dtype and (a == b).all(), (i, name)
+    forwards2 = list(wf2.forwards)
+    for i, state in enumerate(states):
+        if not state:
+            continue
+        gd2 = next(g for g in wf2.gds if g.forward is forwards2[i])
+
+        def check(a, b):
+            if isinstance(a, dict):
+                assert set(a) == set(b)
+                for k in a:
+                    check(a[k], b[k])
+            else:
+                assert (numpy.asarray(a) == numpy.asarray(b)).all()
+
+        check(state, gd2.opt_state)
+    trainer.shutdown()
+
+
+# -- the loopback two-process kill + loss-parity e2e ------------------------
+
+
+def _demo_cmd(out, epochs=3):
+    return [sys.executable, "-m", "veles_tpu.parallel.elastic",
+            "worker-demo", "--out", out, "--epochs", str(epochs)]
+
+
+def _subprocess_env(extra=None):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env.update(extra or {})
+    return env
+
+
+WORKER_ENV = ["--worker-env", "JAX_PLATFORMS=cpu", "--worker-env",
+              "XLA_FLAGS=--xla_force_host_platform_device_count=4"]
+
+
+def test_spmd_kill_mid_epoch_restarts_at_world_1_with_loss_parity(
+        tmp_path):
+    """The acceptance e2e: two supervised jax.distributed DP processes
+    (4 virtual CPU devices each, one 8-way mesh); the rank-1 worker
+    SIGKILLs itself mid-run at the first epoch boundary BEFORE its
+    checkpoint commits (the deterministic mid-epoch death). Its
+    supervisor (crash budget 0) leaves; the survivor's supervisor
+    kills the wedged rank-0 worker, re-forms at world size 1 and
+    restores the generation-initial sharded checkpoint — written at
+    world size 2, restored at world size 1. The rewind replays the
+    lost epoch, so the final loss curve EXACTLY equals an
+    uninterrupted single-process run of the same seeds."""
+    snaps = str(tmp_path / "snaps")
+    base_out = str(tmp_path / "base.json")
+    # baseline: uninterrupted, no supervisor, same 4-device mesh the
+    # restarted survivor trains on
+    base = subprocess.run(
+        _demo_cmd(base_out),
+        env=_subprocess_env(
+            {"JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}),
+        capture_output=True, timeout=300)
+    assert base.returncode == 0, base.stderr.decode(
+        errors="replace")[-3000:]
+
+    server = RendezvousServer(expected=2, min_workers=1, settle_s=0.5,
+                              heartbeat_timeout_s=3.0).start()
+    addr = "%s:%d" % server.address
+    outs = [str(tmp_path / ("h%d.json" % i)) for i in range(2)]
+    procs = []
+    try:
+        for i in range(2):
+            cmd = [sys.executable, "-m", "veles_tpu.parallel.elastic",
+                   "supervise", "--rdzv", addr, "--member", "h%d" % i,
+                   "--snapshots", snaps,
+                   "--max-restarts", "3" if i == 0 else "0",
+                   ] + WORKER_ENV + ["--"] + _demo_cmd(outs[i])
+            extra = {}
+            if i == 1:
+                # rank 1 dies at the first epoch boundary, before
+                # that epoch's checkpoint exists
+                extra["VELES_ELASTIC_TEST_DIE"] = "1:1"
+            procs.append(subprocess.Popen(
+                cmd, env=_subprocess_env(extra),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        logs = []
+        for proc in procs:
+            out, _ = proc.communicate(timeout=420)
+            logs.append(out.decode(errors="replace"))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        server.stop()
+    assert procs[0].returncode == 0, logs[0][-4000:]
+    assert procs[1].returncode == 1, logs[1][-4000:]
+    # the mesh re-formed at world size 1, with the loss recorded
+    assert server.generation >= 1 and server.world_size == 1
+    assert server.lost_total >= 1
+    assert server.phase == "done"
+    history = json.load(open(outs[0]))
+    baseline = json.load(open(base_out))
+    assert len(history) == 3
+    # EXACT equality — the rewind is deterministic (PR 12's
+    # coordinator-tier proof, now at the SPMD tier)
+    assert history == baseline
+    # the world-size-2 initial generation has both part files; the
+    # world-size-1 run checkpointed its own generations after it
+    gens = sorted(d for d in os.listdir(snaps) if d.endswith(".shards"))
+    g0 = str(tmp_path / "snaps" / "wf_g0.0.shards")
+    assert os.path.exists(os.path.join(g0, "part0.pickle.gz"))
+    assert os.path.exists(os.path.join(g0, "part1.pickle.gz"))
+    assert os.path.exists(os.path.join(g0, "MANIFEST.json"))
+    # the world-size-1 run cut generations of its own
+    assert any(d.startswith("wf_g") and not d.startswith("wf_g0.")
+               for d in gens)

@@ -359,3 +359,117 @@ def test_restore_latest_no_loadable_raises(tmp_path):
     (tmp_path / "wf.1.pickle").write_bytes(b"junk")
     with pytest.raises(FileNotFoundError, match="no loadable"):
         restore_latest(str(tmp_path))
+
+
+# -- sharded checkpoint generations (ISSUE 13) ------------------------------
+
+
+def _param_records(wf):
+    """Per-leaf records like FusedTrainer.checkpoint_records, built
+    straight from the unit arrays (jax leaves exercise the SHARD
+    write/assemble path, not the inline one)."""
+    import jax.numpy as jnp
+    records = []
+    for i, fwd in enumerate(wf.forwards):
+        for name, arr in sorted(fwd.param_arrays().items()):
+            records.append(({"kind": "param", "forward": i,
+                             "name": name},
+                            jnp.asarray(arr.map_read())))
+    return records
+
+
+def _save_generation(wf, directory, tag, age_s=None):
+    from veles_tpu import snapshotter as snap
+    path, _ = snap.save_snapshot_sharded(
+        wf, str(directory), _param_records(wf), tag=tag)
+    if age_s is not None:  # deterministic newest-first ordering
+        manifest = os.path.join(path, snap.MANIFEST_NAME)
+        stamp = os.path.getmtime(manifest) - age_s
+        os.utime(manifest, (stamp, stamp))
+    return path
+
+
+def test_sharded_generation_roundtrip_and_current_link(tmp_path):
+    from veles_tpu import snapshotter as snap
+    wf = build(max_epochs=1)
+    wf.run()
+    expected = weights_of(wf)
+    path, _ = snap.save_snapshot_sharded(
+        wf, str(tmp_path), _param_records(wf), tag="_g0", link_tag="")
+    assert path.endswith(".shards")
+    # the _current link points at the generation DIRECTORY
+    assert os.path.realpath(snap.latest_snapshot(str(tmp_path))) == \
+        os.path.realpath(path)
+    wf2, p2 = snap.restore_latest(str(tmp_path))
+    assert os.path.realpath(p2) == os.path.realpath(path)
+    for got, want in zip(weights_of(wf2), expected):
+        assert got.dtype == want.dtype and (got == want).all()
+
+
+def test_sharded_restore_falls_back_past_corrupt_or_missing_shard(
+        tmp_path):
+    """Satellite 2: a corrupt (then missing) single shard file in the
+    newest generation must fall back to the previous COMPLETE
+    generation — the same warn-and-fall-back contract single-file
+    snapshots got in PR 12."""
+    from veles_tpu import snapshotter as snap
+    wf = build(max_epochs=1)
+    wf.run()
+    old_weights = weights_of(wf)
+    _save_generation(wf, tmp_path, "_gOLD", age_s=60)
+    wf.forwards[0].weights.map_write()[...] += 1.0
+    new_path = _save_generation(wf, tmp_path, "_gNEW")
+    # sanity: the intact newest generation wins
+    wf2, p2 = snap.restore_latest(str(tmp_path))
+    assert "_gNEW" in p2
+    assert (weights_of(wf2)[0] == old_weights[0] + 1.0).all()
+    # corrupt ONE shard file (truncated tail: disk-full / torn rsync)
+    part = os.path.join(new_path, "part0.pickle.gz")
+    with open(part, "r+b") as fout:
+        fout.truncate(40)
+    wf3, p3 = snap.restore_latest(str(tmp_path))
+    assert "_gOLD" in p3
+    for got, want in zip(weights_of(wf3), old_weights):
+        assert (got == want).all()
+    # shard file gone entirely: same fallback
+    os.unlink(part)
+    wf4, p4 = snap.restore_latest(str(tmp_path))
+    assert "_gOLD" in p4
+
+
+def test_generation_missing_a_listed_part_falls_back(tmp_path):
+    """A manifest that names a part no longer on disk (shard lost
+    AFTER the commit) is incomplete — never restored over the
+    previous generation."""
+    from veles_tpu import snapshotter as snap
+    wf = build(max_epochs=1)
+    wf.run()
+    old_weights = weights_of(wf)
+    _save_generation(wf, tmp_path, "_gOLD", age_s=60)
+    wf.forwards[0].weights.map_write()[...] += 2.0
+    # world-size-2 layout, but only process 0's part survives
+    snap.save_snapshot_sharded(
+        wf, str(tmp_path), _param_records(wf), tag="_gNEW",
+        process_index=0, process_count=2)
+    wf2, p2 = snap.restore_latest(str(tmp_path))
+    assert "_gOLD" in p2
+    for got, want in zip(weights_of(wf2), old_weights):
+        assert (got == want).all()
+
+
+def test_manifestless_generation_is_never_a_candidate(tmp_path):
+    """A generation whose writer died before the manifest commit is
+    invisible to restores (and to latest_snapshot)."""
+    from veles_tpu import snapshotter as snap
+    wf = build(max_epochs=1)
+    wf.run()
+    _save_generation(wf, tmp_path, "_gOLD", age_s=60)
+    torn = tmp_path / "wf_gTORN.1.shards"
+    torn.mkdir()
+    snap._write_part_file(str(torn), 0, {
+        "format": 1, "part": 0, "records": [],
+        "workflow": dump_workflow(wf)})
+    candidates = snap.snapshot_candidates(str(tmp_path))
+    assert str(torn) not in candidates
+    _, path = snap.restore_latest(str(tmp_path))
+    assert "_gOLD" in path

@@ -18,7 +18,15 @@ plane becomes XLA collectives over ICI/DCN under a single controller:
 * :mod:`coordinator` — the surviving *control* plane: master/slave
   handshake with topology checksum, heartbeats, elastic requeue and
   chaos injection for task farming (genetics/ensemble) and multi-host
-  bring-up. Data never flows through it.
+  bring-up. Data never flows through it;
+* :mod:`elastic`     — the SPMD recovery plane (ISSUE 13):
+  generation-numbered rendezvous, per-host worker supervisors, and
+  sharded checkpoint-restart so a ``jax.distributed`` pod that loses
+  a participant re-forms at the surviving world size instead of
+  wedging (docs/FAULT_TOLERANCE.md §SPMD mesh recovery);
+* :mod:`retry`       — THE shared jittered-backoff retry helper
+  behind every reconnection loop (coordinator dial/re-handshake,
+  ``init_multihost``, rendezvous).
 """
 
 from veles_tpu.parallel.mesh import (build_mesh, local_device_count,  # noqa

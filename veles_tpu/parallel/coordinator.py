@@ -1242,30 +1242,19 @@ class CoordinatorClient(Logger):
 
     def _retry_with_backoff(self, budget_s, attempt_fn):
         """Run ``attempt_fn`` until it succeeds, retrying socket-level
-        failures with exponential backoff (base * 2^n capped at 10 s,
-        each sleep jittered to 50-150% so a fleet never retries in
-        lockstep) inside a bounded budget. THE retry shape for both
-        the initial dial (:meth:`_dial`) and the mid-run re-handshake
-        (:meth:`reconnect`). Raises :class:`ConnectionError` when the
-        budget is exhausted (or the client was closed)."""
-        import random
-        deadline = time.monotonic() + max(budget_s, 0.0)
-        delay = self.backoff_base_s
-        attempt = 0
-        while True:
-            try:
-                return attempt_fn()
-            except (ConnectionError, OSError) as e:
-                attempt += 1
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 or self._closed:
-                    raise ConnectionError(
-                        "could not reach master at %s:%d after %d "
-                        "attempt(s): %s" % (self.address[0],
-                                            self.address[1], attempt, e))
-            sleep = min(delay, remaining) * (0.5 + random.random())
-            time.sleep(min(sleep, max(remaining, 0.0)))
-            delay = min(delay * 2, 10.0)
+        failures with exponential backoff inside a bounded budget —
+        the shared :func:`veles_tpu.parallel.retry.retry_with_backoff`
+        shape (base * 2^n capped at 10 s, 50-150% jitter), used for
+        both the initial dial (:meth:`_dial`) and the mid-run
+        re-handshake (:meth:`reconnect`). Raises
+        :class:`ConnectionError` when the budget is exhausted (or the
+        client was closed)."""
+        from veles_tpu.parallel.retry import retry_with_backoff
+        return retry_with_backoff(
+            attempt_fn, budget_s, base_s=self.backoff_base_s,
+            give_up=lambda e: self._closed,
+            describe="could not reach master at %s:%d" % (
+                self.address[0], self.address[1]))
 
     def _dial(self, budget_s):
         """TCP connect with backoff inside a bounded budget. Only

@@ -34,6 +34,20 @@ class DataParallelTrainer(FusedTrainer):
         self.mesh = mesh if mesh is not None else build_mesh()
         self.axis = axis
         self._param_shardings = param_shardings
+        n_shards = self.mesh.shape[axis]
+        mb = workflow.loader.max_minibatch_size
+        if mb % n_shards:
+            # fail HERE with the constraint spelled out instead of an
+            # opaque sharding error out of jit — this is the check an
+            # elastic restart at a NEW world size hits first (ISSUE 13:
+            # the re-formed mesh must still divide the minibatch, or
+            # the deterministic re-partition of the index matrix
+            # cannot keep every minibatch training exactly once)
+            raise ValueError(
+                "minibatch size %d does not divide over the %r mesh "
+                "axis (%d shards); pick a minibatch the pod's every "
+                "reachable world size divides, or a smaller mesh"
+                % (mb, axis, n_shards))
         # set before super().__init__: _build() compiles the segments,
         # whose in_shardings read this spec
         self._data_spec = named_sharding(self.mesh, axis)

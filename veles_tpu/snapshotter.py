@@ -77,6 +77,24 @@ def _compress(payload, compression):
     return buf.getvalue()
 
 
+def _atomic_write(directory, name, write_fn):
+    """Write ``directory/name`` via a HIDDEN ``.*.tmp`` staging file +
+    rename. ONE copy of the invariant every snapshot artifact relies
+    on: a crash mid-write must neither destroy an existing artifact of
+    the same name nor leave behind anything
+    :func:`snapshot_candidates` could mistake for a candidate (it
+    skips hidden / ``*.tmp`` names). ``write_fn(tmp_path)`` produces
+    the staged content."""
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".", suffix=".tmp")
+    os.close(fd)
+    try:
+        write_fn(tmp)
+        os.replace(tmp, os.path.join(directory, name))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def _open_for_read(path):
     """Open a snapshot for reading, sniffing the compression codec from
     the file's magic bytes (extension-independent, so symlinks or renamed
@@ -301,17 +319,12 @@ def save_snapshot(workflow, directory, tag="", prefix="wf",
     path = os.path.join(directory, name)
     if payload is None:
         payload = dump_workflow(workflow)
-    # write to a temp file then rename: a crash mid-write must not
-    # destroy the previous snapshot of the same name
-    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".", suffix=".tmp")
-    os.close(fd)
-    try:
+
+    def stage(tmp):
         with CODECS.get(compression, open)(tmp, "wb") as fout:
             fout.write(payload)
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+
+    _atomic_write(directory, name, stage)
     link_path = os.path.join(
         directory, "%s%s_current.pickle%s" % (prefix, link_tag, ext))
     # the link_tag (ensemble member id) keeps concurrent members from
@@ -326,29 +339,64 @@ def save_snapshot(workflow, directory, tag="", prefix="wf",
     return path, len(payload)
 
 
+#: suffix of a sharded checkpoint GENERATION directory (ISSUE 13):
+#: per-process ``part<k>.pickle[.gz]`` shard files + a ``MANIFEST.json``
+#: written last by process 0 — the manifest doubles as the completeness
+#: marker, so a generation torn by a mid-save death is never a restore
+#: candidate
+SHARDED_SUFFIX = ".shards"
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def _candidate_mtime(path):
+    """Sort key for candidates: a generation directory ages by its
+    manifest (the last artifact written), not the dir inode."""
+    if os.path.isdir(path):
+        manifest = os.path.join(path, MANIFEST_NAME)
+        if os.path.exists(manifest):
+            return os.path.getmtime(manifest)
+    return os.path.getmtime(path)
+
+
 def snapshot_candidates(directory, prefix=None):
     """Snapshot paths under a :class:`SnapshotterToFile` directory,
     best-first: the ``_current`` link's resolved target leads, the
-    rest follow newest-mtime-first. In-progress staging files
-    (hidden / ``*.tmp``) are never candidates — a restore racing an
-    export must not pick a half-written artifact."""
+    rest follow newest-mtime-first. Candidates are single snapshot
+    files AND sharded-generation directories (``*.shards`` with a
+    manifest). In-progress staging files (hidden / ``*.tmp``) and
+    manifest-less generation dirs are never candidates — a restore
+    racing an export (or surviving a mid-save death) must not pick a
+    half-written artifact."""
     current = None
     rest = []
     for name in os.listdir(directory):
         if name.startswith(".") or name.endswith(".tmp"):
             continue
-        if ".pickle" not in name:
-            continue
+        path = os.path.join(directory, name)
         if prefix is not None and not name.startswith(prefix):
             continue
-        path = os.path.join(directory, name)
         if "_current.pickle" in name:
+            # may resolve to a single file OR a sharded generation
+            # directory (isdir follows symlinks, so this check must
+            # come first or a dir-pointing link gets misclassified)
             resolved = os.path.realpath(path)
+            if os.path.isdir(resolved) and not os.path.exists(
+                    os.path.join(resolved, MANIFEST_NAME)):
+                continue  # link points at a torn generation
             if os.path.exists(resolved):
                 current = resolved
-        else:
+            continue
+        if os.path.isdir(path):
+            if not name.endswith(SHARDED_SUFFIX):
+                continue
+            if not os.path.exists(os.path.join(path, MANIFEST_NAME)):
+                continue  # torn generation: process 0 never finished
             rest.append(path)
-    rest.sort(key=os.path.getmtime, reverse=True)
+            continue
+        if ".pickle" not in name:
+            continue
+        rest.append(path)
+    rest.sort(key=_candidate_mtime, reverse=True)
     if current is not None:
         rest = [p for p in rest if os.path.realpath(p) != current]
         return [current] + rest
@@ -448,7 +496,16 @@ def _loads_snapshot(payload):
 
 
 def load_workflow(path_or_bytes):
-    """Inverse of :func:`dump_workflow`; accepts a path or raw bytes."""
+    """Inverse of :func:`dump_workflow`; accepts a path or raw bytes.
+
+    A path naming a sharded-generation DIRECTORY (ISSUE 13) loads
+    through :func:`load_sharded_generation`: the workflow structure
+    from part 0 plus every param/optimizer leaf re-assembled from the
+    per-process shard files — so every existing restore surface
+    (``restore_latest``, ``SnapshotterToFile.import_``, the serving
+    model store) handles sharded checkpoints transparently."""
+    if isinstance(path_or_bytes, str) and os.path.isdir(path_or_bytes):
+        return load_sharded_generation(path_or_bytes)
     if isinstance(path_or_bytes, bytes):
         blob = _loads_snapshot(path_or_bytes)
     else:
@@ -474,6 +531,244 @@ def load_workflow(path_or_bytes):
     if workflow.checksum != blob["checksum"]:
         workflow.warning("restored workflow checksum differs from the "
                          "one recorded at snapshot time")
+    return workflow
+
+
+# -- sharded (multi-controller) checkpoints — ISSUE 13 -----------------------
+#
+# A distributed SPMD run cannot funnel every parameter through one
+# process when leaves are partitioned over the mesh (and should not
+# serialize a pod's worth of HBM through process 0 even when it could).
+# A *sharded generation* is a directory:
+#
+#     <prefix><tag>.<epoch>.shards/
+#         part0.pickle.gz      # process 0: workflow pickle + its shards
+#         part1.pickle.gz      # process k: its addressable shards
+#         ...
+#         MANIFEST.json        # written LAST by process 0, after the
+#                              # cross-process barrier — its presence is
+#                              # the completeness marker
+#
+# Each process writes exactly the shards it owns (``replica_id == 0``
+# dedupes replicated leaves to one writer), every record carrying the
+# GLOBAL shape + index slices — so a checkpoint taken at world size N
+# restores at world size M: the reader assembles full host arrays from
+# whatever membership wrote them, and the trainer re-shards via
+# ``put_global`` onto the new mesh (Zhuang et al.'s observation that
+# redistribution = gather-by-index + re-place, here through host
+# memory at checkpoint scale). A missing/corrupt part or incomplete
+# coverage raises at load, so ``restore_latest`` falls back to the
+# previous complete generation — the same warn-and-fall-back contract
+# single-file snapshots have.
+
+
+def shard_records(value):
+    """``(meta, entries)`` for one checkpoint leaf as THIS process
+    sees it. ``entries`` is ``[(global_index, ndarray), ...]`` for the
+    addressable shards this process is responsible for (first replica
+    only); non-jax host values return ``(None, None)`` — the caller
+    inlines them on process 0."""
+    import jax
+    import numpy as _np
+    if not isinstance(value, jax.Array):
+        return None, None
+    entries = []
+    for shard in value.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        entries.append((shard.index, _np.asarray(shard.data)))
+    meta = {"shape": tuple(value.shape), "dtype": str(value.dtype)}
+    return meta, entries
+
+
+def _part_name(k, compression="gz"):
+    ext = ("." + compression) if compression else ""
+    return "part%d.pickle%s" % (k, ext)
+
+
+def _write_part_file(gen_dir, k, part, compression="gz"):
+    """Atomically write one process's part file; returns its size."""
+    payload = pickle.dumps(part, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def stage(tmp):
+        with CODECS.get(compression, open)(tmp, "wb") as fout:
+            fout.write(payload)
+
+    _atomic_write(gen_dir, _part_name(k, compression), stage)
+    return len(payload)
+
+
+def _write_manifest(gen_dir, nparts, epoch, checksum=None,
+                    compression="gz", extra=None):
+    import json
+    manifest = {"format": 1, "kind": "veles-sharded-snapshot",
+                "nparts": int(nparts), "epoch": int(epoch),
+                "parts": [_part_name(k, compression)
+                          for k in range(nparts)],
+                "created": time.time(), "checksum": checksum}
+    if extra:
+        manifest.update(extra)
+
+    def stage(tmp):
+        with open(tmp, "w") as fout:
+            json.dump(manifest, fout, indent=1)
+
+    _atomic_write(gen_dir, MANIFEST_NAME, stage)
+
+
+def save_snapshot_sharded(workflow, directory, records, *,
+                          process_index=0, process_count=1, tag="",
+                          prefix="wf", compression="gz", barrier=None,
+                          link_tag=None, manifest_extra=None):
+    """Write THIS process's part of one sharded checkpoint generation.
+
+    ``records``: ``[(spec, value)]`` where ``spec`` is a small JSON-able
+    dict locating the leaf in the workflow (see :func:`_apply_record`)
+    and ``value`` is a ``jax.Array`` (possibly partitioned over a
+    multi-process mesh) or a plain host value. Every process calls this
+    with the SAME records in the same order; each writes only the
+    shards it owns. ``barrier`` (a callable, e.g. wrapping
+    ``multihost_utils.sync_global_devices``) runs after the part write;
+    process 0 then writes the manifest — so a generation becomes a
+    restore candidate only once every part is durably in place.
+
+    Returns ``(generation_dir, bytes_written_by_this_process)``."""
+    epoch = wf_epoch(workflow)
+    name = "%s%s.%d%s" % (prefix, tag, epoch, SHARDED_SUFFIX)
+    gen_dir = os.path.join(directory, name)
+    os.makedirs(gen_dir, exist_ok=True)
+    out_records = []
+    for spec, value in records:
+        meta, entries = shard_records(value)
+        if meta is None:
+            if process_index == 0:
+                out_records.append({"spec": spec, "value": value})
+            continue
+        out_records.append({"spec": spec, "shape": meta["shape"],
+                            "dtype": meta["dtype"], "shards": entries})
+    part = {"format": 1, "part": int(process_index),
+            "records": out_records}
+    if process_index == 0:
+        part["workflow"] = dump_workflow(workflow)
+    nbytes = _write_part_file(gen_dir, process_index, part, compression)
+    if barrier is not None:
+        barrier()
+    if process_index == 0:
+        _write_manifest(gen_dir, process_count, epoch,
+                        checksum=getattr(workflow, "checksum", None),
+                        compression=compression, extra=manifest_extra)
+        if link_tag is not None:
+            link_path = os.path.join(
+                directory, "%s%s_current.pickle" % (prefix, link_tag))
+            try:
+                if os.path.islink(link_path) or os.path.exists(link_path):
+                    os.unlink(link_path)
+                os.symlink(name, link_path)
+            except OSError:
+                pass  # filesystems without symlinks
+    return gen_dir, nbytes
+
+
+def _read_part_file(path):
+    with _open_for_read(path) as fin:
+        part = pickle.load(fin)
+    if not isinstance(part, dict) or "records" not in part:
+        raise pickle.UnpicklingError(
+            "not a sharded-snapshot part: %s" % path)
+    return part
+
+
+def _apply_record(workflow, spec, value):
+    """Install one assembled leaf into the restored workflow.
+
+    Spec kinds (written by ``FusedTrainer.checkpoint_records``):
+
+    * ``{"kind": "param", "forward": i, "name": n}`` — layer weights,
+      replacing the unit Array's host buffer;
+    * ``{"kind": "opt", "forward": i, "path": [...]}`` — one optimizer
+      state leaf of the GD unit attached to forward ``i``.
+    """
+    kind = spec.get("kind")
+    if kind == "param":
+        fwd = list(workflow.forwards)[spec["forward"]]
+        fwd.param_arrays()[spec["name"]].reset(value)
+        return
+    if kind == "opt":
+        fwd = list(workflow.forwards)[spec["forward"]]
+        gd = next((g for g in getattr(workflow, "gds", ())
+                   if g.forward is fwd), None)
+        if gd is None:
+            raise KeyError("no GD unit for forward %d" % spec["forward"])
+        path = list(spec["path"])
+        if not path:
+            gd.opt_state = value
+            return
+        if not isinstance(gd.opt_state, dict):
+            gd.opt_state = {}
+        node = gd.opt_state
+        for key in path[:-1]:
+            nxt = node.get(key)
+            if not isinstance(nxt, dict):
+                nxt = node[key] = {}
+            node = nxt
+        node[path[-1]] = value
+        return
+    raise KeyError("unknown sharded record kind %r" % kind)
+
+
+def load_sharded_generation(gen_dir):
+    """Load one complete sharded generation -> restored workflow.
+
+    Raises when the manifest or ANY part is missing/corrupt, or a
+    leaf's shards do not cover its full global shape — the caller
+    (:func:`restore_latest`) then falls back to the previous complete
+    generation, exactly like a corrupt single-file snapshot."""
+    import json
+    import numpy as _np
+    with open(os.path.join(gen_dir, MANIFEST_NAME)) as fin:
+        manifest = json.load(fin)
+    if manifest.get("kind") != "veles-sharded-snapshot":
+        raise pickle.UnpicklingError(
+            "not a sharded snapshot manifest: %s" % gen_dir)
+    parts = [_read_part_file(os.path.join(gen_dir, name))
+             for name in manifest["parts"]]
+    part0 = next((p for p in parts if "workflow" in p), None)
+    if part0 is None:
+        raise pickle.UnpicklingError(
+            "no part carries the workflow structure: %s" % gen_dir)
+    workflow = load_workflow(part0["workflow"])
+    # assemble every leaf from the union of all parts' shards
+    assembled = {}
+    order = []
+    for part in parts:
+        for rec in part["records"]:
+            key = json.dumps(rec["spec"], sort_keys=True)
+            if key not in assembled:
+                order.append(key)
+                if "value" in rec:
+                    assembled[key] = {"spec": rec["spec"],
+                                      "value": rec["value"]}
+                    continue
+                assembled[key] = {
+                    "spec": rec["spec"],
+                    "out": _np.empty(tuple(rec["shape"]),
+                                     dtype=rec["dtype"]),
+                    "covered": 0}
+            slot = assembled[key]
+            for index, data in rec.get("shards", ()):
+                slot["out"][tuple(index)] = data
+                slot["covered"] += int(data.size)
+    for key in order:
+        slot = assembled[key]
+        if "value" in slot:
+            _apply_record(workflow, slot["spec"], slot["value"])
+            continue
+        if slot["covered"] != slot["out"].size:
+            raise ValueError(
+                "sharded leaf %s covers %d of %d elements in %s — "
+                "incomplete generation" %
+                (key, slot["covered"], slot["out"].size, gen_dir))
+        _apply_record(workflow, slot["spec"], slot["out"])
     return workflow
 
 

@@ -204,6 +204,13 @@ DEFAULT_RULES = (
      "threshold": 0.0, "clear_for_s": 300.0, "severity": "critical",
      "description": "a slave was dropped (death/timeout/straggler) "
                     "and its jobs requeued in the last 5 minutes"},
+    {"name": "spmd_participant_lost", "kind": "increase",
+     "metric": "veles_spmd_participants_lost_total",
+     "window_s": 300.0, "threshold": 0.0, "clear_for_s": 300.0,
+     "severity": "critical",
+     "description": "an SPMD mesh participant was lost in the last 5 "
+                    "minutes; the elastic supervisor re-forms the "
+                    "mesh at the surviving world size (ISSUE 13)"},
 )
 
 

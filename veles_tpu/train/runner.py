@@ -394,6 +394,11 @@ class FusedRunner(Logger):
                 params, states, stats = self._resume_partial_epoch(
                     params, states, offset,
                     confusion_from_train=confusion_from_train)
+                if trainer.epoch_callback is not None:
+                    # the resumed epoch is a closed epoch like any
+                    # other: it must checkpoint, or a later crash
+                    # rewinds past it and replays it twice over
+                    trainer.epoch_callback(trainer, params, states)
                 if services:
                     trainer.push_params(params, states)
                 self._fire_services(services)
@@ -426,6 +431,11 @@ class FusedRunner(Logger):
                 if confusion_from_train and not testing:
                     self._feed_confusion_from_train(params)
                 self._close_epoch(stats)
+                if trainer.epoch_callback is not None:
+                    # the elastic checkpoint seam (ISSUE 13): cut the
+                    # sharded snapshot at the closed-epoch boundary,
+                    # same point the standalone train() loop uses
+                    trainer.epoch_callback(trainer, params, states)
                 if services:
                     # services may pickle/plot the unit arrays, whose
                     # previous buffers the compiled segment donated —

@@ -79,6 +79,13 @@ class FusedTrainer(Logger):
         #: runner reads deltas of this per epoch
         self.input_wait_s = 0.0
         self._active_pipeline = None
+        #: optional ``fn(trainer, params, states)`` fired after EVERY
+        #: closed epoch (both the standalone :meth:`train` loop and the
+        #: production FusedRunner honor it) — the elastic checkpoint
+        #: seam (ISSUE 13): veles_tpu.parallel.elastic hangs its
+        #: per-epoch sharded snapshot here. Observational only: it
+        #: must not mutate params/states.
+        self.epoch_callback = None
         # per-batch global gradient norms ride the train scan (the
         # flight recorder's divergence detector input); the norm is a
         # pure observation over grads the solver reads anyway, so the
@@ -757,6 +764,34 @@ class FusedTrainer(Logger):
                 states.append({})
         return params, tuple(states)
 
+    def checkpoint_records(self, params, states):
+        """``[(spec, leaf)]`` for a sharded checkpoint of the live
+        training state (``snapshotter.save_snapshot_sharded``).
+
+        Deterministic order (forward index, sorted keys/paths) so every
+        SPMD process emits the SAME record list and per-process part
+        files line up shard-for-shard. Specs are the layout
+        ``snapshotter._apply_record`` installs back into a restored
+        workflow's unit Arrays / GD opt states."""
+        records = []
+        for i, layer in enumerate(params):
+            for name in sorted(layer):
+                records.append(({"kind": "param", "forward": i,
+                                 "name": name}, layer[name]))
+
+        def walk(i, node, path):
+            if isinstance(node, dict):
+                for key in sorted(node):
+                    walk(i, node[key], path + [key])
+                return
+            records.append(({"kind": "opt", "forward": i,
+                             "path": path}, node))
+
+        for i, state in enumerate(states):
+            if state:
+                walk(i, state, [])
+        return records
+
     def push_params(self, params, states):
         """Device pytrees -> unit Arrays (after training)."""
         for fwd, p, s in zip(self.forwards, params, states):
@@ -819,12 +854,28 @@ class FusedTrainer(Logger):
                 "normalized": metric_sum / max(n, 1),
                 "loss": float(jnp.mean(losses))}
 
-    def train(self, max_epochs=None):
-        """Full training loop with the decision unit's stop criterion."""
+    def train(self, max_epochs=None, epoch_callback=None,
+              initial_state=None):
+        """Full training loop with the decision unit's stop criterion.
+
+        ``epoch_callback`` (or the :attr:`epoch_callback` attribute)
+        fires after each epoch's bookkeeping closes — with the live
+        ``(trainer, params, states)`` — which is exactly the complete
+        step boundary an elastic checkpoint must be cut at. A restored
+        workflow resumes transparently: the loop starts from the
+        loader's ``epoch_number`` and the decision's restored history/
+        best-state carry the stop criterion forward.
+        ``initial_state`` accepts an already-pulled ``(params,
+        states)`` so a caller that needed them before the loop (the
+        elastic generation-initial checkpoint) does not pay the
+        host→device placement twice."""
         decision = self.decision
         max_epochs = max_epochs if max_epochs is not None \
             else decision.max_epochs
-        params, states = self.pull_params()
+        callback = (epoch_callback if epoch_callback is not None
+                    else self.epoch_callback)
+        params, states = (initial_state if initial_state is not None
+                          else self.pull_params())
         epoch = self.loader.epoch_number
         start = time.perf_counter()
         while True:
@@ -843,6 +894,8 @@ class FusedTrainer(Logger):
             self.info("epoch %d: %s", epoch, "  ".join(
                 "%s=%.4f" % (k, v["normalized"])
                 for k, v in stats.items() if isinstance(v, dict)))
+            if callback is not None:
+                callback(self, params, states)
             epoch += 1
             if max_epochs is not None and epoch >= max_epochs:
                 break
