@@ -199,7 +199,8 @@ def main():
 
     import bench  # repo-root bench.py: shared matmul-peak measurement
 
-    names = sys.argv[1:] or list(CONFIGS) + ["som", "serving"]
+    names = sys.argv[1:] or list(CONFIGS) + [
+        "som", "serving", "serving-cache", "serving-burst"]
     set_policy(PRECISION)
     peak = bench.measured_matmul_peak_tflops()
     print("chip matmul peak: %.1f TF/s, policy=%s, window>=%.0fs"
@@ -210,16 +211,20 @@ def main():
     print("|---|---|---|---|---|---|")
     for name in names:
         t0 = time.time()
-        if name == "serving":
+        if name == "serving" or name.startswith("serving-"):
             # the serving engine has its own metric shape (QPS vs the
             # legacy path, not samples/s vs MFU) — delegate and print
-            # its row verbatim after the table
+            # its row verbatim after the table. "serving" is the
+            # ISSUE 3 baseline; "serving-{cache,burst,diurnal,
+            # multitenant}" are the ISSUE 14 elastic-plane scenarios
             import bench_serving
-            result = bench_serving.run(quick=True)
+            scenario = name[len("serving-"):] if "-" in name \
+                else "baseline"
+            result = bench_serving.SCENARIOS[scenario](quick=True)
             print(bench_serving.markdown_row(result), flush=True)
-            print("serving: %.1fx in %.0fs total"
-                  % (result["speedup"], time.time() - t0),
-                  file=sys.stderr)
+            print("%s: %s in %.0fs total"
+                  % (name, "PASS" if result["pass"] else "FAIL",
+                     time.time() - t0), file=sys.stderr)
             continue
         if name == "som":
             rate, flops, label = bench_som()

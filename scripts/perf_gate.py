@@ -288,6 +288,161 @@ def _spmd_recovery_probe():
     return {"spmd_recovery_time_s": recovery}
 
 
+class _ProbePool(object):
+    """A replica-pool stand-in with a fixed host-side service delay
+    per batch: the serving probes below are SLEEP-dominated (like the
+    input-pipeline probe) so their ratios are structural, not
+    machine-speed. Results are computed with real numpy so the cache
+    bit-identity contract stays honest."""
+
+    def __init__(self, weights, delay_s=0.004, max_batch_size=8):
+        import queue as _queue
+        import threading as _threading
+        self.max_batch_size = max_batch_size
+        self._w = weights
+        self._delay = delay_s
+        self._queue = _queue.Queue()
+        self._busy = 0
+        self._stop = _threading.Event()
+        self._thread = _threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+        class _Model(object):
+            name = "probe"
+            version = 1
+            sample_shape = (weights.shape[0],)
+
+        self.model = _Model()
+
+    def _loop(self):
+        import numpy
+        while not self._stop.is_set():
+            try:
+                batch, on_done = self._queue.get(timeout=0.05)
+            except Exception:
+                continue
+            self._busy = 1
+            time.sleep(self._delay)          # the "forward"
+            on_done(numpy.tanh(batch @ self._w), batch.shape[0], None)
+            self._busy = 0
+
+    def any_idle(self):
+        return self._busy == 0 and self._queue.empty()
+
+    def submit(self, batch, on_done):
+        self._queue.put((batch, on_done))
+
+    def stats(self):
+        return [{"load": self._busy}]
+
+    def size(self):
+        return 1
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def _serving_cache_probe(requests=200, hot=8, delay_s=0.004):
+    """ISSUE 14 cache guard (hard): repeat-heavy traffic (``hot``
+    distinct inputs, ``requests`` total) through the dynamic batcher
+    with the result cache on vs off, against a fixed-delay service.
+    Cache-off pays the delay per request; cache-on pays it ``hot``
+    times — the ratio is ~requests/hot by construction, and collapses
+    to ~1 if the consult-before-admission path silently breaks."""
+    import numpy
+
+    from veles_tpu.serving.cache import ResultCache
+    from veles_tpu.serving.engine import DynamicBatcher
+
+    rng = numpy.random.RandomState(SEED)
+    weights = rng.rand(16, 4).astype(numpy.float32)
+    rows = [rng.rand(16).astype(numpy.float32) for _ in range(hot)]
+
+    def measure(cache):
+        pool = _ProbePool(weights, delay_s=delay_s)
+        batcher = DynamicBatcher(pool, batch_timeout_ms=0.0,
+                                 max_queue=64, cache=cache)
+        try:
+            t0 = time.perf_counter()
+            for i in range(requests):
+                batcher.submit(rows[i % hot]).result(timeout=60)
+            return time.perf_counter() - t0
+        finally:
+            batcher.stop()
+            pool.stop()
+
+    t_off = measure(None)
+    t_on = measure(ResultCache(model="perf-gate"))
+    return {"serving_cache_hit_speedup": t_off / max(t_on, 1e-9)}
+
+
+def _serving_elastic_probe(delay_s=0.01, backlog=120):
+    """ISSUE 14 autoscale guard (report-only): a real replica pool on
+    a tiny jitted model, flooded so the queue breaches; measured are
+    the p95 of request completion under the burst and the autoscaler's
+    breach -> warmed-replica reaction time. Report-only: both carry
+    real compile/wall time and shared CI runners are noisy; the
+    structural assertions live in tests/test_serving_elastic.py."""
+    import numpy
+
+    from veles_tpu.serving.autoscale import Autoscaler
+    from veles_tpu.serving.engine import DynamicBatcher
+    from veles_tpu.serving.model_store import ServeableModel
+    from veles_tpu.serving.replica import ReplicaPool
+    from veles_tpu.telemetry.registry import MetricsRegistry
+
+    rng = numpy.random.RandomState(SEED)
+    weights = rng.rand(64, 8).astype(numpy.float32)
+
+    def apply(params, x):
+        import jax.numpy as jnp
+        return jnp.tanh(jnp.dot(x.reshape((x.shape[0], -1)),
+                                params["w"]))
+
+    model = ServeableModel([(apply, {"w": weights})], (64,),
+                           name="probe")
+
+    class _Slow(ServeableModel):
+        def forward_fn(self):
+            inner = ServeableModel.forward_fn(self)
+
+            def forward(x):
+                time.sleep(delay_s)     # traced once per bucket; the
+                return inner(x)         # backlog outlives every trace
+
+            return forward
+
+    slow = _Slow(model.layers, model.sample_shape, name="probe")
+    registry = MetricsRegistry()
+    pool = ReplicaPool(slow, n_replicas=1, max_batch_size=4, warm=False)
+    batcher = DynamicBatcher(pool, batch_timeout_ms=0.0, max_queue=1024)
+    scaler = Autoscaler(pool, batcher, min_replicas=1, max_replicas=2,
+                        up_queue_per_replica=8.0, up_for_s=0.05,
+                        up_cooldown_s=0.0, interval_s=0.02,
+                        registry=registry)
+    try:
+        xs = rng.rand(backlog, 64).astype(numpy.float32)
+        t0 = time.perf_counter()
+        futures = [batcher.submit(x) for x in xs]
+        scaler.start()
+        done_ms = []
+        for f in futures:
+            f.result(timeout=120)
+            done_ms.append((time.perf_counter() - t0) * 1e3)
+        hist = registry.get("veles_autoscale_reaction_s")
+        child = hist.labels(model="default")
+        reaction = child.sum / child.count if child.count else -1.0
+    finally:
+        scaler.stop()
+        batcher.stop()
+        pool.stop()
+    done_ms.sort()
+    return {"serving_burst_p95_ms":
+            done_ms[int(0.95 * (len(done_ms) - 1))],
+            "autoscale_reaction_s": reaction}
+
+
 def capture():
     """Run the probe and return the snapshot dict."""
     from veles_tpu.telemetry import profiler
@@ -322,6 +477,8 @@ def capture():
     metrics.update(_federation_probe())
     metrics.update(_recovery_probe())
     metrics.update(_spmd_recovery_probe())
+    metrics.update(_serving_cache_probe())
+    metrics.update(_serving_elastic_probe())
     return {"schema": "veles-perf-snapshot/1",
             "probe": {"samples": SAMPLES, "batch": BATCH,
                       "epochs": EPOCHS, "seed": SEED},

@@ -334,3 +334,70 @@ def test_spmd_participant_lost_rule_fires_on_counter(monkeypatch):
     lost.labels(reason="connection_lost").inc()
     engine.evaluate(now=t + 400)
     assert engine.active() == ["spmd_participant_lost"]
+
+
+# -- ISSUE 14 serving-plane rules -------------------------------------------
+
+
+def test_serving_cache_collapse_rule_fires_on_low_hit_ratio():
+    """The default rule: a mature cache whose windowed hit ratio
+    collapses below 5% fires; an idle server (gauge never published)
+    stays quiet forever."""
+    from veles_tpu.telemetry.alerts import DEFAULT_RULES
+    spec = next(r for r in DEFAULT_RULES
+                if r["name"] == "serving_cache_collapse")
+    reg = MetricsRegistry()
+    engine = _engine(reg, spec)
+    t = 1000.0
+    engine.evaluate(now=t)
+    assert engine.active() == []          # no gauge -> no opinion
+    ratio = reg.gauge("veles_serving_cache_hit_ratio",
+                      labels=("model",))
+    ratio.labels(model="m").set(0.01)
+    engine.evaluate(now=t + 1)
+    engine.evaluate(now=t + 35)           # held for for_s=30
+    assert engine.active() == ["serving_cache_collapse"]
+    ratio.labels(model="m").set(0.6)      # traffic warmed back up
+    engine.evaluate(now=t + 40)
+    engine.evaluate(now=t + 75)
+    assert engine.active() == []
+
+
+def test_autoscale_flap_rule_fires_on_transition_churn():
+    from veles_tpu.telemetry.alerts import DEFAULT_RULES
+    spec = next(r for r in DEFAULT_RULES if r["name"] == "autoscale_flap")
+    reg = MetricsRegistry()
+    transitions = reg.counter("veles_autoscale_transitions_total",
+                              labels=("model", "direction"))
+    transitions.labels(model="m", direction="up").inc(0)
+    engine = _engine(reg, spec)
+    t = 1000.0
+    for i in range(0, 130, 10):           # mature the 60s window
+        engine.evaluate(now=t + i)
+    assert engine.active() == []
+    # one up/down pair per evaluation: 6 transitions inside a minute
+    for i, direction in enumerate(["up", "down"] * 3):
+        transitions.labels(model="m", direction=direction).inc()
+        engine.evaluate(now=t + 130 + i * 5)
+    assert engine.active() == ["autoscale_flap"]
+
+
+def test_tenant_shed_burn_rule_fires_per_tenant():
+    from veles_tpu.telemetry.alerts import DEFAULT_RULES
+    spec = next(r for r in DEFAULT_RULES
+                if r["name"] == "tenant_shed_burn")
+    assert spec["severity"] == "critical"
+    reg = MetricsRegistry()
+    shed = reg.gauge("veles_serving_tenant_shed_ratio",
+                     labels=("tenant",))
+    shed.labels(tenant="calm").set(0.0)
+    engine = _engine(reg, spec)
+    t = 1000.0
+    engine.evaluate(now=t)
+    engine.evaluate(now=t + 15)
+    assert engine.active() == []          # nobody over the bar
+    # agg=max: ONE drowning tenant is enough, however calm the rest
+    shed.labels(tenant="greedy").set(0.8)
+    engine.evaluate(now=t + 20)
+    engine.evaluate(now=t + 35)
+    assert engine.active() == ["tenant_shed_burn"]

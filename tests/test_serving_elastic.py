@@ -1,0 +1,885 @@
+"""The elastic serving plane (ISSUE 14): result cache, per-tenant QoS
+admission, pool elasticity + autoscaler, multi-model routing, and
+model-store retention.
+
+The headline contracts:
+
+* a cache hit is bit-identical to the computed result and survives
+  nothing across a hot swap (epoch fence — no stale result served);
+* a greedy tenant sheds onto itself: the starved tenant still gets
+  its weighted share;
+* scale-down drains — zero in-flight requests die;
+* scale-up under fire grows the pool and every admitted request still
+  completes;
+* one process serves N models with isolated routes and pools.
+"""
+
+import os
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.models.mnist import MnistWorkflow
+from veles_tpu.serving.admission import (AdmissionController,
+                                         TenantOverloaded)
+from veles_tpu.serving.autoscale import Autoscaler
+from veles_tpu.serving.cache import ResultCache
+from veles_tpu.serving.engine import DynamicBatcher
+from veles_tpu.serving.model_store import (ModelLoadError, ModelStore,
+                                           ServeableModel)
+from veles_tpu.serving.replica import Replica, ReplicaPool
+from veles_tpu.telemetry.registry import MetricsRegistry
+
+
+class tiny_digits(object):
+    """Picklable provider (loaders ride inside snapshots)."""
+
+    def __call__(self):
+        rng = numpy.random.RandomState(7)
+        return (rng.rand(60, 12, 12).astype(numpy.float32),
+                rng.randint(0, 10, 60).astype(numpy.int32),
+                rng.rand(20, 12, 12).astype(numpy.float32),
+                rng.randint(0, 10, 20).astype(numpy.int32))
+
+
+@pytest.fixture(scope="module")
+def trained():
+    prng.get().seed(31)
+    prng.get("loader").seed(32)
+    wf = MnistWorkflow(DummyLauncher(), provider=tiny_digits(),
+                       layers=(16,), minibatch_size=20, max_epochs=1)
+    wf.initialize(device=Device(backend="cpu"))
+    wf.run()
+    return wf
+
+
+@pytest.fixture(scope="module")
+def model(trained):
+    return ServeableModel.from_workflow(trained, name="mnist")
+
+
+def _perturbed(model, delta=0.5, version=1):
+    return ServeableModel(
+        [(fn, {k: v + delta for k, v in params.items()})
+         for fn, params in model.layers],
+        model.sample_shape, name=model.name, version=version)
+
+
+class _SlowModel(ServeableModel):
+    """Each forward sleeps host-side so queues can back up."""
+
+    def __init__(self, base, delay=0.05):
+        super(_SlowModel, self).__init__(base.layers, base.sample_shape,
+                                         name=base.name)
+        self._delay = delay
+
+    def forward_fn(self):
+        inner = super(_SlowModel, self).forward_fn()
+
+        def forward(x):
+            time.sleep(self._delay)
+            return inner(x)
+
+        return forward
+
+
+# -- result cache ----------------------------------------------------------
+
+
+def test_cache_key_is_content_addressed():
+    reg = MetricsRegistry()
+    ResultCache(registry=reg)  # metric wiring must not blow up
+    a = numpy.arange(4, dtype=numpy.float32)
+    same = numpy.arange(4, dtype=numpy.float32)
+    other = numpy.arange(4, dtype=numpy.float32) + 1
+    assert ResultCache.key_for(a, "m", 1) == \
+        ResultCache.key_for(same, "m", 1)
+    assert ResultCache.key_for(a, "m", 1) != \
+        ResultCache.key_for(other, "m", 1)
+    # the model identity is part of the address: a new version can
+    # never collide with the old one's entries
+    assert ResultCache.key_for(a, "m", 1) != \
+        ResultCache.key_for(a, "m", 2)
+    assert ResultCache.key_for(a, "m", 1) != \
+        ResultCache.key_for(a, "n", 1)
+
+
+def test_cache_lru_byte_budget_and_ttl():
+    reg = MetricsRegistry()
+    value = numpy.zeros(100, numpy.float32)     # 400 B payload
+    cache = ResultCache(max_bytes=3 * (len(b"x" * 20) + value.nbytes),
+                        ttl_s=10.0, registry=reg)
+    keys = [ResultCache.key_for(
+        numpy.full(4, i, numpy.float32), "m", 1) for i in range(4)]
+    token = cache.token()
+    for i, key in enumerate(keys[:3]):
+        assert cache.put(key, value, token, now=100.0 + i)
+    assert len(cache) == 3
+    cache.get(keys[0], now=104.0)               # 0 is now MRU
+    assert cache.put(keys[3], value, token, now=105.0)
+    assert len(cache) == 3                      # budget forced one out
+    assert cache.get(keys[1], now=105.0) is None   # LRU victim
+    assert cache.get(keys[0], now=105.0) is not None
+    stats = cache.stats()
+    assert stats["evictions"] == 1
+    # TTL: an entry older than ttl_s is a miss and drops on touch
+    assert cache.get(keys[0], now=200.0) is None
+    assert cache.stats()["entries"] == 2
+
+
+def test_cache_invalidate_fences_inflight_puts():
+    reg = MetricsRegistry()
+    cache = ResultCache(registry=reg)
+    key = ResultCache.key_for(numpy.zeros(4, numpy.float32), "m", 1)
+    token = cache.token()
+    cache.put(key, numpy.ones(4), token)
+    assert cache.get(key) is not None
+    dropped = cache.invalidate()
+    assert dropped == 1 and cache.get(key) is None
+    # a result computed against the pre-invalidation model is REFUSED
+    assert not cache.put(key, numpy.ones(4), token)
+    assert cache.get(key) is None
+    assert cache.put(key, numpy.ones(4), cache.token())
+
+
+def test_engine_cache_hit_is_bit_identical_and_skips_batching(model):
+    reg = MetricsRegistry()
+    cache = ResultCache(registry=reg, model="hit-test")
+    pool = ReplicaPool(model, n_replicas=1, max_batch_size=8,
+                       warm=False)
+    batcher = DynamicBatcher(pool, batch_timeout_ms=1, max_queue=32,
+                             cache=cache)
+    try:
+        x = numpy.random.RandomState(0).rand(144).astype(numpy.float32)
+        first = batcher.submit(x).result(timeout=30)
+        t0 = time.perf_counter()
+        again = batcher.submit(x).result(timeout=30)
+        hit_s = time.perf_counter() - t0
+        numpy.testing.assert_array_equal(first, again)   # bit-identical
+        stats = cache.stats()
+        assert stats["hits"] == 1 and stats["misses"] == 1
+        assert hit_s < 0.05     # no window, no forward — a dict lookup
+        # admission never saw the hit
+        assert batcher.queue_depth() == 0
+    finally:
+        batcher.stop()
+        pool.stop()
+
+
+def test_cache_invalidation_on_hot_swap_is_atomic(model):
+    """After swap_model returns, the cached v1 result must never be
+    served again — the no-stale-result contract."""
+    from veles_tpu.serving.frontend import ServingFrontend
+    fe = ServingFrontend(model, port=0, replicas=1, max_batch_size=8,
+                         batch_timeout_ms=1, max_queue=64,
+                         cache_mb=4, warm=False).start()
+    try:
+        import json
+        import urllib.request
+
+        def post(payload):
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/api" % fe.port,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=20) as resp:
+                return json.loads(resp.read())
+
+        x = numpy.random.RandomState(1).rand(144).astype(numpy.float32)
+        body = {"input": x.tolist(), "codec": "list"}
+        before = post(body)["result"]
+        cached = post(body)["result"]           # served from the cache
+        numpy.testing.assert_array_equal(before, cached)
+        assert fe.cache.stats()["hits"] >= 1
+        v2 = _perturbed(model)
+        fe.swap_model(v2)
+        after = post(body)["result"]
+        assert not numpy.allclose(after, before)
+        numpy.testing.assert_allclose(after, v2(x[None])[0], rtol=1e-5)
+        # and the v2 answer now caches under the v2 key
+        numpy.testing.assert_array_equal(post(body)["result"], after)
+    finally:
+        fe.stop()
+
+
+# -- per-tenant QoS admission ----------------------------------------------
+
+
+def test_greedy_tenant_cannot_starve_weighted_share():
+    reg = MetricsRegistry()
+    ctl = AdmissionController(
+        capacity=8, tenants={"greedy": {"weight": 1.0},
+                             "light": {"weight": 1.0}}, registry=reg)
+    now = 1000.0
+    # the light tenant is live (one admitted+settled request)
+    ctl.admit("light", now=now)
+    ctl.settle("light", now=now)
+    # the greedy client hammers: it gets ITS share (4 of 8) and the
+    # rest of its burst sheds onto itself...
+    admitted = 0
+    for _ in range(20):
+        try:
+            ctl.admit("greedy", now=now + 0.1)
+            admitted += 1
+        except TenantOverloaded as e:
+            assert e.tenant == "greedy"
+    assert admitted == 4
+    # ...while the light tenant's reserved share admits every one of
+    # its requests
+    for _ in range(4):
+        ctl.admit("light", now=now + 0.2)
+    # and the hard global cap still holds
+    with pytest.raises(TenantOverloaded):
+        ctl.admit("light", now=now + 0.3)
+    stats = ctl.stats(now=now + 0.3)
+    assert stats["outstanding"] == 8
+    assert stats["tenants"]["greedy"]["shed"] == 16
+
+
+def test_idle_tenant_share_is_lent_and_reclaimed():
+    reg = MetricsRegistry()
+    ctl = AdmissionController(
+        capacity=8, tenants={"a": {"weight": 1.0},
+                             "b": {"weight": 1.0}},
+        activity_window_s=5.0, registry=reg)
+    now = 1000.0
+    # b has never been active: a may borrow the whole capacity
+    for _ in range(8):
+        ctl.admit("a", now=now)
+    with pytest.raises(TenantOverloaded):
+        ctl.admit("a", now=now)
+    # a drains; b turns up and becomes active again
+    for _ in range(8):
+        ctl.settle("a", now=now + 1.0)
+    ctl.admit("b", now=now + 1.0)
+    ctl.settle("b", now=now + 1.0)
+    # within b's activity window, a is back to its guaranteed 4 —
+    # b's unused share is reserved, not borrowable
+    admitted = 0
+    for _ in range(8):
+        try:
+            ctl.admit("a", now=now + 2.0)
+            admitted += 1
+        except TenantOverloaded:
+            break
+    assert admitted == 4
+
+
+def test_qos_class_multiplies_share():
+    reg = MetricsRegistry()
+    ctl = AdmissionController(
+        capacity=10,
+        tenants={"fg": {"weight": 1.0, "qos": "interactive"},
+                 "bg": {"weight": 1.0, "qos": "best_effort"}},
+        registry=reg)
+    now = 1000.0
+    ctl.admit("bg", now=now)
+    ctl.settle("bg", now=now)
+    # interactive is 4x best_effort: shares 8 vs 2
+    admitted = 0
+    for _ in range(12):
+        try:
+            ctl.admit("fg", now=now + 0.1)
+            admitted += 1
+        except TenantOverloaded:
+            break
+    assert admitted == 8
+    stats = ctl.stats(now=now + 0.1)
+    assert stats["tenants"]["fg"]["share"] == 8.0
+    assert stats["tenants"]["bg"]["share"] == 2.0
+
+
+def test_retry_after_tracks_tenant_drain_rate():
+    reg = MetricsRegistry()
+    ctl = AdmissionController(capacity=4, registry=reg,
+                              drain_window_s=10.0)
+    now = 1000.0
+    for _ in range(4):
+        ctl.admit("t", now=now)
+    # 2 completions over the 10s window -> 0.2/s drain; 4 outstanding
+    # -> ~20s to clear
+    ctl.settle("t", now=now + 1.0)
+    ctl.settle("t", now=now + 2.0)
+    for _ in range(2):
+        ctl.admit("t", now=now + 3.0)
+    with pytest.raises(TenantOverloaded) as e:
+        ctl.admit("t", now=now + 3.0)
+    assert e.value.retry_after == 20
+    # no drain history at all: optimistic single-second retry
+    ctl2 = AdmissionController(capacity=1, registry=MetricsRegistry())
+    ctl2.admit("u", now=now)
+    with pytest.raises(TenantOverloaded) as e2:
+        ctl2.admit("u", now=now)
+    assert e2.value.retry_after == 1
+
+
+def test_configure_pins_qos_against_client_promotion():
+    reg = MetricsRegistry()
+    ctl = AdmissionController(capacity=8, registry=reg)
+    ctl.configure("t", weight=2.0, qos="best_effort", pin_qos=True)
+    ctl.admit("t", qos="interactive", now=1000.0)   # ignored: pinned
+    assert ctl.stats(now=1000.0)["tenants"]["t"]["qos"] == "best_effort"
+
+
+# -- pool elasticity -------------------------------------------------------
+
+
+def test_scale_down_drain_loses_zero_inflight(model):
+    slow = _SlowModel(model, delay=0.03)
+    pool = ReplicaPool(slow, n_replicas=2, max_batch_size=4, warm=False)
+    batcher = DynamicBatcher(pool, batch_timeout_ms=0, max_queue=256)
+    try:
+        xs = numpy.random.RandomState(2).rand(30, 144).astype(
+            numpy.float32)
+        futures = [batcher.submit(x) for x in xs]
+        removed = pool.remove_replica(timeout=60)   # mid-flight
+        assert removed is not None
+        assert pool.size() == 1
+        results = [f.result(timeout=60) for f in futures]
+        assert len(results) == 30                   # zero dropped
+        # allclose, not equal: the drained rows ran in whatever batch
+        # shapes the collector formed, and XLA's reduction order
+        # differs across compiled batch sizes
+        numpy.testing.assert_allclose(numpy.stack(results), model(xs),
+                                      rtol=1e-5, atol=1e-7)
+        # the pool never removes its last replica
+        assert pool.remove_replica(timeout=5) is None
+    finally:
+        batcher.stop()
+        pool.stop()
+
+
+def test_add_replica_serves_and_records_warmup_phase(model):
+    from veles_tpu.telemetry import profiler
+    profiler.reset_phases()
+    pool = ReplicaPool(model, n_replicas=1, max_batch_size=4, warm=True)
+    try:
+        assert profiler.phase_report().get("replica_warmup", 0) > 0
+        added = pool.add_replica()
+        assert pool.size() == 2
+        assert added.warmed_buckets == [1, 2, 4]    # warm BEFORE dispatch
+        done = threading.Event()
+        got = []
+        pool.submit(numpy.ones((1, 144), numpy.float32),
+                    lambda out, b, e: (got.append((out, e)), done.set()))
+        assert done.wait(30) and got[0][1] is None
+    finally:
+        pool.stop()
+
+
+# -- autoscaler ------------------------------------------------------------
+
+
+class _FakePool(object):
+    def __init__(self, n=1):
+        self.n = n
+        self.busy = 0
+        self.max_batch_size = 8
+
+    def size(self):
+        return self.n
+
+    def stats(self):
+        return [{"load": 1 if i < self.busy else 0}
+                for i in range(self.n)]
+
+    def add_replica(self):
+        self.n += 1
+
+    def remove_replica(self, timeout=60.0):
+        if self.n <= 1:
+            return None
+        self.n -= 1
+        return object()
+
+
+class _FakeAdmission(object):
+    def __init__(self):
+        self.shed = 0
+
+    def stats(self):
+        return {"tenants": {"t": {"shed": self.shed}}}
+
+
+class _FakeBatcher(object):
+    def __init__(self):
+        self.depth = 0
+        self.admission = _FakeAdmission()
+
+    def queue_depth(self):
+        return self.depth
+
+
+def _scaler(pool, batcher, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("up_queue_per_replica", 8.0)
+    kw.setdefault("up_for_s", 1.0)
+    kw.setdefault("up_cooldown_s", 2.0)
+    kw.setdefault("down_idle_for_s", 10.0)
+    kw.setdefault("down_cooldown_s", 10.0)
+    return Autoscaler(pool, batcher, min_replicas=1, max_replicas=3,
+                      **kw)
+
+
+def test_autoscaler_scales_up_on_sustained_queue_depth():
+    pool, batcher = _FakePool(1), _FakeBatcher()
+    scaler = _scaler(pool, batcher)
+    batcher.depth = 20                  # 20 > 8*1
+    assert scaler.tick(now=100.0) == 0  # breach must HOLD up_for_s
+    assert scaler.tick(now=100.5) == 0
+    assert scaler.tick(now=101.1) == 1
+    assert pool.n == 2
+    # still deep, but inside the cooldown: no second replica yet
+    assert scaler.tick(now=101.2) == 0
+    # sustained pressure through the cooldown (raise the depth so the
+    # per-replica threshold still trips at 2 replicas): the already-
+    # open breach window fires the moment the cooldown expires
+    batcher.depth = 40
+    assert scaler.tick(now=103.5) == 1
+    assert pool.n == 3
+    # max_replicas is a hard ceiling
+    batcher.depth = 100
+    assert scaler.tick(now=110.0) == 0
+    assert scaler.tick(now=111.5) == 0
+    assert pool.n == 3
+
+
+def test_autoscaler_shed_burst_scales_up_fast():
+    pool, batcher = _FakePool(1), _FakeBatcher()
+    scaler = _scaler(pool, batcher, up_for_s=0.5)
+    assert scaler.tick(now=99.0) == 0   # primes the shed-delta sample
+    batcher.admission.shed = 5          # clients are being 503'd NOW
+    assert scaler.tick(now=100.0) == 0  # breach opens
+    batcher.admission.shed = 9
+    assert scaler.tick(now=100.6) == 1  # ...and fires after up_for_s
+    assert pool.n == 2
+
+
+def test_autoscaler_scale_down_is_slow_and_hysteretic():
+    pool, batcher = _FakePool(2), _FakeBatcher()
+    scaler = _scaler(pool, batcher)
+    # idle, but the evidence must hold down_idle_for_s
+    assert scaler.tick(now=100.0) == 0
+    assert scaler.tick(now=105.0) == 0
+    assert scaler.tick(now=110.5) == -1
+    assert pool.n == 1
+    # never below min_replicas
+    assert scaler.tick(now=130.0) == 0
+    assert scaler.tick(now=141.0) == 0
+    assert pool.n == 1
+    # a blip of traffic resets the idle window (no down right after)
+    pool.n = 2
+    assert scaler.tick(now=150.0) == 0          # idle window opens
+    batcher.depth = 3                           # blip (below up bar)
+    assert scaler.tick(now=155.0) == 0
+    batcher.depth = 0
+    assert scaler.tick(now=160.9) == 0          # idle window restarts
+    assert scaler.tick(now=166.0) == 0          # only ~5s idle so far
+    assert scaler.tick(now=171.0) == -1         # full window held
+
+
+def test_autoscaler_flap_is_impossible_after_scale_up():
+    """The anti-flap contract: a scale-up immediately followed by
+    silence must NOT scale down until a full idle window + cooldown."""
+    pool, batcher = _FakePool(1), _FakeBatcher()
+    scaler = _scaler(pool, batcher, down_cooldown_s=20.0)
+    batcher.depth = 50
+    scaler.tick(now=100.0)
+    assert scaler.tick(now=101.1) == 1
+    batcher.depth = 0                   # burst gone instantly
+    for t in numpy.arange(101.2, 120.0, 1.0):
+        assert scaler.tick(now=float(t)) == 0   # cooldown holds it
+    assert scaler.tick(now=122.0) == -1          # then, calmly, down
+
+
+def test_autoscaler_reaction_time_recorded():
+    reg = MetricsRegistry()
+    pool, batcher = _FakePool(1), _FakeBatcher()
+    scaler = _scaler(pool, batcher, registry=reg)
+    batcher.depth = 20
+    scaler.tick(now=100.0)
+    scaler.tick(now=101.5)
+    hist = reg.get("veles_autoscale_reaction_s")
+    (labels, child), = hist.series()
+    assert child.count == 1
+    assert child.sum >= 1.4             # the 1.5 s evidence window
+    replicas = reg.get("veles_autoscale_replicas")
+    assert replicas.labels(model="default").value == 2
+
+
+def test_scale_up_under_fire_completes_every_admitted_request(model):
+    """Live engine + autoscaler: a backlog forces a scale-up while
+    requests are in flight; every admitted future must resolve and the
+    pool must have grown."""
+    slow = _SlowModel(model, delay=0.02)
+    pool = ReplicaPool(slow, n_replicas=1, max_batch_size=2, warm=False)
+    batcher = DynamicBatcher(pool, batch_timeout_ms=0, max_queue=512)
+    scaler = Autoscaler(pool, batcher, min_replicas=1, max_replicas=3,
+                        up_queue_per_replica=4.0, up_for_s=0.0,
+                        up_cooldown_s=0.0, interval_s=0.05,
+                        registry=MetricsRegistry())
+    try:
+        xs = numpy.random.RandomState(3).rand(60, 144).astype(
+            numpy.float32)
+        futures = [batcher.submit(x) for x in xs]
+        scaler.start()
+        results = [f.result(timeout=120) for f in futures]
+        assert len(results) == 60
+        numpy.testing.assert_array_equal(results[0], model(xs[:1])[0])
+        deadline = time.time() + 10
+        while time.time() < deadline and pool.size() < 2:
+            time.sleep(0.02)
+        assert pool.size() >= 2, "autoscaler never grew the pool"
+    finally:
+        scaler.stop()
+        batcher.stop()
+        pool.stop()
+
+
+# -- multi-model routing ---------------------------------------------------
+
+
+def test_multi_model_routing_isolation(model):
+    import json
+    import urllib.error
+    import urllib.request
+
+    from veles_tpu.serving.frontend import ServingFrontend
+    other = _perturbed(model, delta=0.25)
+    fe = ServingFrontend({"alpha": model, "beta": other}, port=0,
+                         replicas=1, max_batch_size=8,
+                         batch_timeout_ms=1, max_queue=64, cache_mb=0,
+                         warm=False).start()
+    try:
+        def post(path, payload):
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d%s" % (fe.port, path),
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=20) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        x = numpy.random.RandomState(4).rand(144).astype(numpy.float32)
+        body = {"input": x.tolist(), "codec": "list"}
+        status_a, reply_a = post("/api/alpha", body)
+        status_b, reply_b = post("/api/beta", body)
+        assert status_a == 200 and status_b == 200
+        numpy.testing.assert_allclose(reply_a["result"],
+                                      model(x[None])[0], rtol=1e-5)
+        numpy.testing.assert_allclose(reply_b["result"],
+                                      other(x[None])[0], rtol=1e-5)
+        assert not numpy.allclose(reply_a["result"], reply_b["result"])
+        # the bare path serves the default (first) model unchanged
+        status_d, reply_d = post("/api", body)
+        assert status_d == 200
+        numpy.testing.assert_array_equal(reply_d["result"],
+                                         reply_a["result"])
+        # batch endpoint routes per model too
+        status, batch_b = post("/api/beta/batch",
+                               {"inputs": [x.tolist()], "codec": "list"})
+        assert status == 200
+        numpy.testing.assert_array_equal(batch_b["results"][0],
+                                         reply_b["result"])
+        # unknown model -> 404, not a crash
+        status, reply = post("/api/gamma", body)
+        assert status == 404
+        # healthz lists every hosted model with its route
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/healthz" % fe.port,
+                timeout=10) as resp:
+            health = json.loads(resp.read())
+        assert set(health["models"]) == {"alpha", "beta"}
+        assert health["models"]["beta"]["path"] == "/api/beta"
+        # per-model swap only touches its own entry
+        v2 = _perturbed(model, delta=0.1)
+        fe.swap_model(v2, name="beta")
+        _, after_b = post("/api/beta", body)
+        assert not numpy.allclose(after_b["result"], reply_b["result"])
+        _, after_a = post("/api/alpha", body)
+        numpy.testing.assert_array_equal(after_a["result"],
+                                         reply_a["result"])
+    finally:
+        fe.stop()
+
+
+def test_duplicate_or_reserved_route_rejected(model):
+    from veles_tpu.serving.frontend import ServingFrontend
+    with pytest.raises(ValueError):
+        ServingFrontend({"batch": model}, port=0, warm=False)
+
+
+# -- model store retention -------------------------------------------------
+
+
+def _stub_model(name, version, source=None):
+    return ServeableModel([], (4,), name=name, version=version,
+                          source=source)
+
+
+def test_store_keep_last_retains_newest_and_pinned():
+    store = ModelStore(keep_last=2)
+    store.add(_stub_model("m", 1), version=1)
+    store.pin("m", 1)
+    for v in (2, 3, 4):
+        store.add(_stub_model("m", v), version=v)
+    # pinned v1 survives every sweep; v2/v3 were retired
+    assert store.versions("m") == [1, 4]
+    assert store.get("m", version=1) is not None
+    with pytest.raises(KeyError):
+        store.get("m", version=2)
+    # unpinned stores keep exactly the newest K
+    store2 = ModelStore(keep_last=2)
+    for v in (1, 2, 3, 4):
+        store2.add(_stub_model("m", v), version=v)
+    assert store2.versions("m") == [3, 4]
+
+
+def test_store_prune_disk_removes_retired_snapshot_files(tmp_path):
+    files = []
+    for v in (1, 2, 3):
+        path = tmp_path / ("snap_v%d.pickle" % v)
+        path.write_bytes(b"weights")
+        files.append(str(path))
+    store = ModelStore(keep_last=1, prune_disk=True)
+    for v, path in enumerate(files, start=1):
+        store.add(_stub_model("m", v, source=path), version=v)
+    assert store.versions("m") == [3]
+    assert not os.path.exists(files[0])
+    assert not os.path.exists(files[1])
+    assert os.path.exists(files[2])     # the serving version stays
+
+
+def test_store_prune_disk_spares_shared_and_foreign_sources(tmp_path):
+    shared = tmp_path / "shared.pickle"
+    shared.write_bytes(b"weights")
+    store = ModelStore(keep_last=1, prune_disk=True)
+    # two names loaded from one file: retiring one must not delete
+    # the other's source
+    store.add(_stub_model("a", 1, source=str(shared)), version=1)
+    store.add(_stub_model("b", 1, source=str(shared)), version=1)
+    store.add(_stub_model("a", 2, source=None), version=2)
+    assert store.versions("a") == [2]
+    assert shared.exists()
+
+
+def test_corrupt_newest_snapshot_is_skipped(trained, tmp_path):
+    """A torn/corrupt newest snapshot must not stop the server from
+    coming up — the next-newest loadable snapshot serves instead."""
+    from veles_tpu.snapshotter import SnapshotterToFile
+    snap = SnapshotterToFile(trained, directory=str(tmp_path),
+                             prefix="srv", interval=1, time_interval=0)
+    snap.initialize()
+    snap.time = 0
+    snap.export()
+    good = snap.destination
+    # a newer, torn artifact (crash mid-copy) + no _current link
+    for name in os.listdir(str(tmp_path)):
+        if "_current" in name:
+            os.remove(os.path.join(str(tmp_path), name))
+    bad = tmp_path / "srv_zzz.pickle.gz"
+    bad.write_bytes(b"\x1f\x8b totally not a snapshot")
+    newer = os.path.getmtime(good) + 60
+    os.utime(str(bad), (newer, newer))
+    store = ModelStore()
+    loaded = store.load(str(tmp_path), name="mnist")
+    assert loaded.source == good
+    x = numpy.random.RandomState(5).rand(2, 144).astype(numpy.float32)
+    assert loaded(x).shape == (2, 10)
+    # every candidate corrupt -> a clear error, not a stack of noise
+    bad.write_bytes(b"junk")
+    os.remove(good)
+    with pytest.raises(ModelLoadError):
+        ModelStore().load(str(tmp_path), name="mnist")
+
+
+# -- review hardening: races, cardinality, CLI parsing ---------------------
+
+
+def test_retired_replica_refuses_batches(model):
+    """The scale-down race: a batch picked before the victim left
+    dispatch must be REFUSED (and re-picked), never stranded on a
+    drained queue with its futures hung."""
+    pool = ReplicaPool(model, n_replicas=2, warm=False)
+    try:
+        victim = pool.replicas[1]
+        victim.retire()
+        batch = numpy.zeros((1,) + model.sample_shape, numpy.float32)
+        assert victim.submit(batch, lambda *a: None) is False
+        assert victim.load == 0           # nothing charged on refusal
+        # pool-level submit re-picks the survivor and still completes
+        done = threading.Event()
+        seen = []
+
+        def cb(rows, bucket, error):
+            seen.append((rows, error))
+            done.set()
+
+        pool.submit(batch, cb)
+        assert done.wait(60)
+        assert seen[0][1] is None
+        # un-retire restores acceptance (the drain-stall revert path)
+        victim.retire(False)
+        assert victim.submit(batch, lambda *a: None) is True
+        assert victim.wait_drained(60)
+    finally:
+        pool.stop()
+
+
+def test_results_writable_when_cache_disabled(model):
+    """Without a cache each caller owns a private copy — freezing it
+    (needed only for the cached share) would regress in-place use."""
+    pool = ReplicaPool(model, n_replicas=1, warm=False)
+    engine = DynamicBatcher(pool, batch_timeout_ms=1.0)
+    try:
+        x = numpy.zeros(model.sample_shape, numpy.float32)
+        out = engine.submit(x).result(timeout=60)
+        out += 1.0                        # must not raise
+    finally:
+        engine.stop()
+        pool.stop()
+
+
+def test_tenant_cardinality_capped_overflow_aliases():
+    """X-Tenant is client-controlled: past the cap, unknown names
+    share the overflow bucket instead of growing accounting/metrics
+    without bound — and settle via the RETURNED name balances."""
+    reg = MetricsRegistry()
+    ctl = AdmissionController(capacity=100, max_tenants=4,
+                              registry=reg)
+    now = 1000.0
+    for i in range(4):
+        assert ctl.admit("t%d" % i, now=now) == "t%d" % i
+    # every bucket busy at the same instant: the spray degrades into
+    # one shared tenant
+    assert ctl.admit("sprayed-1", now=now) == "overflow"
+    assert ctl.admit("sprayed-2", now=now) == "overflow"
+    tenants = ctl.stats(now=now)["tenants"]
+    assert set(tenants) == {"t0", "t1", "t2", "t3", "overflow"}
+    assert tenants["overflow"]["outstanding"] == 2
+    ctl.settle("overflow", now=now)
+    assert ctl.stats(now=now)["tenants"]["overflow"]["outstanding"] == 1
+
+
+def test_idle_autocreated_tenants_evicted_configured_exempt():
+    reg = MetricsRegistry()
+    ctl = AdmissionController(capacity=100, max_tenants=2,
+                              tenants={"vip": {"weight": 2.0}},
+                              activity_window_s=5.0, registry=reg)
+    now = 1000.0
+    ctl.admit("vip", now=now)
+    ctl.settle("vip", now=now)
+    ctl.admit("x", now=now)
+    ctl.settle("x", now=now)
+    # both idle past the window: the auto-created bucket is evicted
+    # (accounting AND metric children), the operator-configured one
+    # never is
+    assert ctl.admit("y", now=now + 10.0) == "y"
+    assert set(ctl.stats(now=now + 10.0)["tenants"]) == {"vip", "y"}
+    text = reg.render_prometheus()
+    assert 'tenant="x"' not in text
+    assert 'tenant="y"' in text
+
+
+def test_parse_models_rejects_duplicates():
+    from veles_tpu.serving.frontend import _parse_models
+    assert _parse_models(["a=1.snap"]) == {"a": "1.snap"}
+    assert _parse_models(["x.snap"]) == "x.snap"
+    with pytest.raises(ValueError, match="duplicate model route"):
+        _parse_models(["a=1.snap", "a=2.snap"])
+    # two bare paths used to silently drop the first artifact
+    with pytest.raises(ValueError, match="name= prefix"):
+        _parse_models(["a.snap", "b.snap"])
+
+
+def test_add_replica_promotes_if_pool_swapped_while_warming(
+        model, monkeypatch):
+    """A swap landing while a new replica warms against the OLD
+    version must not let it join dispatch stale — it would serve v1
+    results (and poison the cache under v2 keys) forever."""
+    import veles_tpu.serving.replica as replica_mod
+    pool = ReplicaPool(model, n_replicas=1, max_batch_size=4,
+                       warm=False)
+    v2 = _perturbed(model, delta=0.25, version=2)
+    orig_bind = replica_mod.Replica._bind
+    raced = []
+
+    def racing_bind(self, m, warm=True):
+        orig_bind(self, m, warm=warm)
+        if self.index == 1 and not raced:
+            raced.append(True)
+            pool.swap(v2)          # the promotion lands mid-warm
+
+    monkeypatch.setattr(replica_mod.Replica, "_bind", racing_bind)
+    try:
+        added = pool.add_replica()
+        assert added.model is v2   # promoted before joining dispatch
+        assert all(r.model is v2 for r in pool.replicas)
+    finally:
+        pool.stop()
+
+
+def test_admission_metrics_are_per_model():
+    """Multi-model serving runs one controller per model over ONE
+    registry: the families carry the model label, and one model's
+    idle-eviction must not reset another's live children."""
+    import re
+    reg = MetricsRegistry()
+    a = AdmissionController(capacity=10, max_tenants=2,
+                            activity_window_s=5.0, registry=reg,
+                            model="a")
+    b = AdmissionController(capacity=10, registry=reg, model="b")
+    now = 1000.0
+    a.admit("acme", now=now)
+    a.settle("acme", now=now)
+    b.admit("acme", now=now)
+    values = {m.group(1): float(m.group(2)) for m in re.finditer(
+        r'veles_serving_tenant_outstanding\{model="(\w+)",'
+        r'tenant="acme"\}\s+([\d.]+)', reg.render_prometheus())}
+    assert values == {"a": 0.0, "b": 1.0}
+    # controller a evicts its idle acme bucket for a new name...
+    a.admit("x", now=now + 10.0)
+    a.admit("y", now=now + 20.0)
+    text = reg.render_prometheus()
+    assert 'model="a",tenant="acme"' not in text
+    # ...and b's live acme children survive untouched
+    assert 'model="b",tenant="acme"' in text
+
+
+def test_route_requires_separator(model):
+    from veles_tpu.serving.frontend import ServingFrontend
+    fe = ServingFrontend(model, port=0, replicas=1, max_batch_size=4,
+                         cache_mb=0, warm=False)
+    try:
+        assert fe._route("/api/mnist") is not None
+        assert fe._route("/api") is not None
+        assert fe._route("/apimnist") is None    # typo'd URL: 404
+    finally:
+        fe.stop()
+
+
+def test_store_routes_with_shared_model_name_do_not_collide(model):
+    """Two routes hosting variants that share a model name keep
+    separate store entries keyed by ROUTE — and the caller's model
+    object is never renamed."""
+    from veles_tpu.serving.frontend import ServingFrontend
+    other = _perturbed(model, delta=0.25)
+    assert other.name == model.name == "mnist"
+    fe = ServingFrontend({"alpha": model, "beta": other}, port=0,
+                         replicas=1, max_batch_size=4, cache_mb=0,
+                         warm=False)
+    try:
+        assert fe.store.get("alpha") is model
+        assert fe.store.get("beta") is other
+        assert model.name == "mnist"             # not mutated
+    finally:
+        fe.stop()

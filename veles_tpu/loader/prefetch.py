@@ -333,6 +333,20 @@ def default_placer(device=None):
     return jax.device_put
 
 
+def warmup_ring(slots=2, device=None):
+    """A small :class:`StagingRing` for serving-replica warm-up.
+
+    The replica bucket sweep (``serving/replica.py``) stages each
+    bucket's zeros through this ring instead of materializing every
+    bucket on device at once: two slots bound the sweep's HBM
+    footprint to the two largest consecutive buckets, and on real
+    accelerators the async ``device_put`` overlaps the previous
+    bucket's compile — the same double-buffering the training input
+    pipeline uses, reused as the H2D path for serving cold starts
+    (ROADMAP item 4, serving half)."""
+    return StagingRing(slots, default_placer(device))
+
+
 # -- residency planning ------------------------------------------------------
 
 

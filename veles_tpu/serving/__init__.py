@@ -4,18 +4,30 @@ The training side of the stack serves HTTP through
 :class:`~veles_tpu.restful_api.RESTfulAPI` riding a live workflow: one
 request, one forward dispatch. This package is the production serving
 path the ROADMAP north star asks for — concurrent requests coalesce
-into hardware-sized batches, one jitted forward runs per batch, and a
-pool of warm model replicas absorbs the traffic:
+into hardware-sized batches, one jitted forward runs per batch, and an
+**elastic** pool of warm model replicas absorbs the traffic:
 
 * :mod:`~veles_tpu.serving.model_store` — load serveable models from
   :class:`~veles_tpu.snapshotter.SnapshotterToFile` outputs, live
-  workflows or ``export/`` packages; version pinning and hot-swap.
+  workflows or ``export/`` packages; version pinning, hot-swap, and
+  keep-last-K retention so long-running servers don't hoard versions.
 * :mod:`~veles_tpu.serving.replica` — N model replicas with warm JIT
-  caches keyed by batch-shape buckets, least-loaded dispatch.
-* :mod:`~veles_tpu.serving.engine` — the dynamic batcher: bounded
-  admission queue, pad-to-bucket batching, scatter back to futures.
+  caches keyed by batch-shape buckets, least-loaded dispatch,
+  grow/shrink under live traffic (scale-down drains, zero in-flight
+  loss; warm-up rides the staging-ring H2D path).
+* :mod:`~veles_tpu.serving.engine` — the dynamic batcher: result
+  cache consult → tenant admission → pad-to-bucket batching → scatter
+  back to futures (and into the cache).
+* :mod:`~veles_tpu.serving.cache` — content-addressed LRU result
+  cache with byte budget, TTL, and epoch invalidation on hot swap.
+* :mod:`~veles_tpu.serving.admission` — weighted-fair per-tenant QoS
+  admission (interactive > batch > best_effort); an overloaded tenant
+  sheds onto itself with Retry-After from its own drain rate.
+* :mod:`~veles_tpu.serving.autoscale` — telemetry-driven replica
+  autoscaler: bursts scale up fast, idle drains slow, flap never.
 * :mod:`~veles_tpu.serving.frontend` — the HTTP frontend (same request
-  contract as ``restful_api``), overload → 503 + ``Retry-After``.
+  contract as ``restful_api``), multi-model routing by name, overload
+  → 503 + ``Retry-After``.
 * :mod:`~veles_tpu.serving.metrics` — QPS / queue depth / batch
   occupancy / latency percentiles, exposed at ``/metrics`` and pushed
   to the :mod:`~veles_tpu.web_status` dashboard.

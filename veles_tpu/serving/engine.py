@@ -1,18 +1,29 @@
 """The dynamic batcher: coalesce requests, one forward per batch.
 
 Requests enter through :meth:`DynamicBatcher.submit` (one sample → one
-:class:`concurrent.futures.Future`). Admission is a **bounded** queue:
-when it is full, ``submit`` raises :class:`EngineOverloaded`
-immediately — the frontend turns that into HTTP 503 + ``Retry-After``,
-so overload sheds load instead of stacking unbounded blocked threads
-(the failure mode the old one-request-one-dispatch path had).
+:class:`concurrent.futures.Future`). Two new strata sit in front of
+the batch queue (ISSUE 14):
+
+* a **content-addressed result cache**
+  (:class:`~veles_tpu.serving.cache.ResultCache`): a hit returns an
+  already-resolved future before admission is even consulted — hot
+  repeated inputs cost one dict lookup, zero accelerator time;
+* **per-tenant QoS admission**
+  (:class:`~veles_tpu.serving.admission.AdmissionController`):
+  weighted-fair shares with QoS classes replace PR 3's single global
+  outstanding cap, so an overloaded tenant sheds onto itself — the
+  frontend turns :class:`EngineOverloaded` (or its per-tenant subclass
+  ``TenantOverloaded``) into HTTP 503 + ``Retry-After`` computed from
+  that tenant's own drain rate.
 
 The batcher thread collects up to ``max_batch_size`` samples or waits
 at most ``batch_timeout_ms`` past the first sample of a batch — the
 standard latency/throughput knob: a lone request pays at most the
 window; a burst fills the batch instantly and never waits. Collected
 batches go to the replica pool (least-loaded replica, padded to a warm
-bucket) and results scatter back row-by-row to the waiting futures.
+bucket) and results scatter back row-by-row to the waiting futures —
+and, on the way out, into the cache (epoch-fenced, so a result
+computed against a swapped-out model version is dropped, not cached).
 Dispatch is asynchronous: while replica A runs batch N, the batcher is
 already collecting batch N+1 for replica B.
 """
@@ -36,19 +47,25 @@ class EngineOverloaded(Exception):
 
 
 class _Request(object):
-    __slots__ = ("sample", "future", "enqueued_at")
+    __slots__ = ("sample", "future", "enqueued_at", "tenant",
+                 "cache_key", "cache_token")
 
-    def __init__(self, sample):
+    def __init__(self, sample, tenant=None, cache_key=None,
+                 cache_token=None):
         self.sample = sample
         self.future = concurrent.futures.Future()
         self.enqueued_at = time.time()
+        self.tenant = tenant
+        self.cache_key = cache_key
+        self.cache_token = cache_token
 
 
 class DynamicBatcher(Logger):
-    """Collect → pad → forward → scatter, against a replica pool."""
+    """Cache → admit → collect → pad → forward → scatter."""
 
     def __init__(self, pool, max_batch_size=None, batch_timeout_ms=5.0,
-                 max_queue=256, metrics=None):
+                 max_queue=256, metrics=None, cache=None,
+                 admission=None):
         super(DynamicBatcher, self).__init__()
         self.pool = pool
         self.max_batch_size = int(max_batch_size or pool.max_batch_size)
@@ -57,14 +74,21 @@ class DynamicBatcher(Logger):
         # admission bounds TOTAL outstanding samples (waiting for the
         # batcher + dispatched to a replica but not yet scattered) —
         # bounding only the pre-batcher queue would let the unbounded
-        # replica queues absorb arbitrary backlog and defeat the 503
+        # replica queues absorb arbitrary backlog and defeat the 503.
+        # The controller's default tenant owning 100% of the capacity
+        # IS the old global cap; named tenants split it weighted-fair.
         self.max_queue = int(max_queue)
-        self._outstanding = 0
-        self._outstanding_lock = threading.Lock()
+        if admission is None:
+            from veles_tpu.serving.admission import AdmissionController
+            admission = AdmissionController(capacity=self.max_queue)
+        self.admission = admission
+        self.cache = cache
         self.metrics = metrics
         if metrics is not None:
             metrics.attach_queue_depth(self.queue_depth)
             metrics.attach_replica_stats(pool.stats)
+            if cache is not None:
+                metrics.attach_cache_stats(cache.stats)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._batch_loop,
                                         daemon=True, name="batcher")
@@ -72,10 +96,13 @@ class DynamicBatcher(Logger):
 
     # -- request side ------------------------------------------------------
 
-    def submit(self, sample):
-        """One sample in, one Future out; EngineOverloaded when full."""
+    def submit(self, sample, tenant=None, qos=None):
+        """One sample in, one Future out; EngineOverloaded when the
+        tenant's share (or the engine) is full. A cache hit resolves
+        immediately — no admission, no batch, no forward."""
         sample = numpy.ascontiguousarray(sample, numpy.float32)
-        expected = self.pool.model.sample_shape
+        model = self.pool.model
+        expected = model.sample_shape
         if tuple(sample.shape) != expected:
             try:
                 sample = sample.reshape(expected)
@@ -83,13 +110,26 @@ class DynamicBatcher(Logger):
                 raise ValueError(
                     "sample shape %s does not match the model's %s" %
                     (tuple(sample.shape), expected))
-        request = _Request(sample)
         if self._stop.is_set():
             raise EngineOverloaded("engine stopped", retry_after=5)
-        with self._outstanding_lock:
-            if self._outstanding >= self.max_queue:
-                raise EngineOverloaded(retry_after=1)
-            self._outstanding += 1
+        cache_key = cache_token = None
+        if self.cache is not None:
+            cache_key = self.cache.key_for(sample, model.name,
+                                           model.version)
+            hit = self.cache.get(cache_key)
+            if hit is not None:
+                future = concurrent.futures.Future()
+                future.set_result(hit)       # read-only cached array
+                if self.metrics is not None:
+                    self.metrics.record_cache_hit()
+                return future
+            cache_token = self.cache.token()
+        # raises on shed; returns the accounting bucket (an unknown
+        # tenant past the cap aliases to "overflow" — settle must use
+        # the same bucket or outstanding counts leak)
+        tenant = self.admission.admit(tenant, qos=qos)
+        request = _Request(sample, tenant=tenant, cache_key=cache_key,
+                           cache_token=cache_token)
         self._queue.put(request)
         if self._stop.is_set():
             # stop() may have drained the queue between the check above
@@ -107,16 +147,11 @@ class DynamicBatcher(Logger):
                 break
             request.future.set_exception(
                 EngineOverloaded("engine stopped", retry_after=5))
-            self._settle(1)
-
-    def _settle(self, n):
-        with self._outstanding_lock:
-            self._outstanding -= n
+            self.admission.settle(request.tenant)
 
     def queue_depth(self):
         """Outstanding samples (admission-queue + in-replica)."""
-        with self._outstanding_lock:
-            return self._outstanding
+        return self.admission.total_outstanding()
 
     # -- batcher thread ----------------------------------------------------
 
@@ -160,7 +195,8 @@ class DynamicBatcher(Logger):
 
     def _scatter_cb(self, requests):
         def scatter(result, bucket, error):
-            self._settle(len(requests))
+            for r in requests:
+                self.admission.settle(r.tenant)
             if error is not None:
                 for r in requests:
                     if not r.future.done():
@@ -169,9 +205,18 @@ class DynamicBatcher(Logger):
             if self.metrics is not None:
                 self.metrics.record_batch(len(requests), bucket)
             for i, r in enumerate(requests):
+                row = numpy.array(result[i], copy=True)
+                if self.cache is not None and r.cache_key is not None:
+                    # the same array is handed to the client AND
+                    # cached: freezing it makes the share safe (the
+                    # frontend only serializes it), and a cache hit
+                    # later returns it without another copy —
+                    # bit-identical by construction. Cache off keeps
+                    # the per-caller copy writable, as before.
+                    row.setflags(write=False)
+                    self.cache.put(r.cache_key, row, r.cache_token)
                 if not r.future.done():
-                    r.future.set_result(
-                        numpy.array(result[i], copy=True))
+                    r.future.set_result(row)
         return scatter
 
     # -- lifecycle ---------------------------------------------------------

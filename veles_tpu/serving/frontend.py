@@ -8,27 +8,39 @@ Speaks the same request contract as
 * ``POST <path>/batch`` — ``{"inputs": [...], "codec": "list"}`` (or
   base64 with a leading batch dim in ``shape``): the rows ride the same
   dynamic batcher and come back as ``{"results": [...]}`` in order.
+* **Multi-model routing** (ISSUE 14): one process hosts N models from
+  the same :class:`ModelStore` — ``POST <path>/<model>`` (and
+  ``<path>/<model>/batch``) routes by model name, each model with its
+  own replica pool, result cache, tenant buckets and autoscaler; the
+  bare ``<path>`` stays wired to the default (first) model so
+  single-model clients never change.
 * ``GET /metrics`` — Prometheus text exposition of the process-wide
   telemetry registry (serving + any co-resident training series).
 * ``GET /metrics.json`` — the JSON metrics snapshot
-  (:class:`~veles_tpu.serving.metrics.ServingMetrics`).
+  (:class:`~veles_tpu.serving.metrics.ServingMetrics`), with
+  per-tenant admission stats and per-model blocks.
 * ``GET /profile.json`` — the performance-attribution report
   (:func:`veles_tpu.telemetry.profiler.profile_report`): per-bucket
   forward cost/roofline rows, memory sample, startup phases.
-* ``GET /healthz`` — liveness + current model name/version.
+* ``GET /healthz`` — liveness + current model name/version (every
+  hosted model listed under ``"models"``).
+
+Per-tenant QoS: the ``X-Tenant`` header (or the body's ``"tenant"``)
+names the client's admission bucket; ``X-QoS`` (or ``"qos"``) declares
+``interactive``/``batch``/``best_effort``. Overload answers **HTTP 503
+with Retry-After computed from that tenant's own drain rate** — a
+greedy tenant sheds onto itself, not onto everyone
+(``serving/admission.py``).
 
 A client-supplied ``X-Request-Id`` header (or the body's ``"id"``)
 becomes the trace id of the request's span, so a single request can be
 found in a ``--trace-out`` dump by the id the client already logs.
 
-Admission control is the engine's bounded queue: overload returns
-**HTTP 503 with a Retry-After header** immediately — the frontend never
-parks a client thread behind a saturated accelerator.
-
 Run standalone: ``python -m veles_tpu serve --model <snapshot|package>``
-(see :func:`main` for flags, ``docs/SERVING.md`` for the operations
-guide). With ``--web-status host:port`` the frontend pushes its metrics
-block to the dashboard, rendered in ``/status.html``.
+(``--model`` repeats, ``name=path`` names a route; see :func:`main`
+for the autoscale/cache/tenant flags, ``docs/SERVING.md`` for the
+operations guide). With ``--web-status host:port`` the frontend pushes
+its metrics block to the dashboard, rendered in ``/status.html``.
 """
 
 import argparse
@@ -45,6 +57,10 @@ from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.restful_api import (_NumpyJSONEncoder, parse_payload,
                                    respond_json)
+from veles_tpu.serving.admission import (QOS_MULTIPLIER,
+                                         AdmissionController)
+from veles_tpu.serving.autoscale import Autoscaler
+from veles_tpu.serving.cache import ResultCache
 from veles_tpu.serving.engine import DynamicBatcher, EngineOverloaded
 from veles_tpu.serving.metrics import ServingMetrics
 from veles_tpu.serving.model_store import ModelStore
@@ -76,40 +92,139 @@ class _FrontendServer(ThreadingHTTPServer):
     request_queue_size = 128
 
 
-class ServingFrontend(Logger):
-    """The serving process: model store + replica pool + batcher + HTTP.
+class _ModelEntry(object):
+    """Everything one hosted model owns: pool, batcher, cache,
+    admission buckets, metrics, optional autoscaler."""
 
-    ``model`` may be a :class:`ServeableModel` or a path/URI the store
-    can load. ``swap_model(source)`` hot-swaps live traffic onto a new
-    version (drain each replica in turn, promote, re-warm).
+    def __init__(self, name, model, replicas, max_batch_size,
+                 batch_timeout_ms, max_queue, warm, cache_mb,
+                 cache_ttl_s, tenants, min_replicas, max_replicas,
+                 autoscale_interval_s):
+        self.name = name
+        self.metrics = ServingMetrics(model_label=name)
+        self.metrics.set_model(model.name, model.version)
+        self.pool = ReplicaPool(model, n_replicas=replicas,
+                                max_batch_size=max_batch_size,
+                                warm=warm)
+        self.cache = ResultCache(max_bytes=int(cache_mb * (1 << 20)),
+                                 ttl_s=cache_ttl_s,
+                                 model=name) if cache_mb else None
+        self.admission = AdmissionController(capacity=max_queue,
+                                             tenants=tenants,
+                                             model=name)
+        self.engine = DynamicBatcher(
+            self.pool, max_batch_size=max_batch_size,
+            batch_timeout_ms=batch_timeout_ms, max_queue=max_queue,
+            metrics=self.metrics, cache=self.cache,
+            admission=self.admission)
+        self.autoscaler = None
+        if max_replicas is not None and max_replicas > 0:
+            self.autoscaler = Autoscaler(
+                self.pool, self.engine,
+                min_replicas=min_replicas or replicas,
+                max_replicas=max_replicas,
+                interval_s=autoscale_interval_s, model=name)
+
+    @property
+    def model(self):
+        return self.pool.model
+
+    def snapshot(self):
+        snap = self.metrics.snapshot()
+        snap["tenants"] = self.admission.stats()["tenants"]
+        if self.autoscaler is not None:
+            snap["autoscale"] = {
+                "replicas": self.pool.size(),
+                "min": self.autoscaler.min_replicas,
+                "max": self.autoscaler.max_replicas,
+            }
+        return snap
+
+    def stop(self):
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self.engine.stop()
+        self.pool.stop()
+
+
+class ServingFrontend(Logger):
+    """The serving process: model store + N model entries + HTTP.
+
+    ``model`` may be one :class:`ServeableModel` or path/URI (the
+    single-model shape every PR 3 client uses), or a list/dict of
+    them — a dict's keys name the routes, otherwise each model's own
+    name does. ``swap_model(source, name=...)`` hot-swaps one entry's
+    live traffic onto a new version (drain each replica in turn,
+    promote, re-warm, atomically invalidate that model's result
+    cache).
     """
 
     def __init__(self, model, host="", port=8180, path="/api",
                  replicas=1, max_batch_size=64, batch_timeout_ms=5.0,
-                 max_queue=256, response_timeout=30.0, warm=True):
+                 max_queue=256, response_timeout=30.0, warm=True,
+                 cache_mb=64, cache_ttl_s=300.0, tenants=None,
+                 tenant_header="X-Tenant", qos_header="X-QoS",
+                 min_replicas=None, max_replicas=None,
+                 autoscale_interval_s=0.5, store=None,
+                 keep_last=None):
         super(ServingFrontend, self).__init__()
-        self.store = ModelStore()
-        if isinstance(model, str):
-            model = self.store.load(model)
-        else:
-            self.store.add(model, version=model.version)
+        self.store = store or ModelStore(keep_last=keep_last)
         self.path = path
         self.response_timeout = float(response_timeout)
-        self.metrics = ServingMetrics()
-        self.metrics.set_model(model.name, model.version)
-        self.pool = ReplicaPool(model, n_replicas=replicas,
-                                max_batch_size=max_batch_size, warm=warm)
-        self.engine = DynamicBatcher(
-            self.pool, max_batch_size=max_batch_size,
-            batch_timeout_ms=batch_timeout_ms, max_queue=max_queue,
-            metrics=self.metrics)
-        self._server = _FrontendServer((host, port), _FrontendHandler)
+        self.tenant_header = tenant_header
+        self.qos_header = qos_header
+        self.entries = {}
+        if isinstance(model, dict):
+            specs = list(model.items())
+        elif isinstance(model, (list, tuple)):
+            specs = [(None, m) for m in model]
+        else:
+            specs = [(None, model)]
+        try:
+            for name, source in specs:
+                if isinstance(source, str):
+                    served = self.store.load(source, name=name)
+                else:
+                    # keyed by the ROUTE: two routes serving variants
+                    # that share a model name must not overwrite each
+                    # other's store entries
+                    served = self.store.add(source,
+                                            version=source.version,
+                                            name=name)
+                route = name or served.name
+                if route == "batch" or "/" in route:
+                    raise ValueError(
+                        "model route %r collides with the request "
+                        "paths (rename it)" % route)
+                if route in self.entries:
+                    raise ValueError("duplicate model route %r"
+                                     % route)
+                self.entries[route] = _ModelEntry(
+                    route, served, replicas, max_batch_size,
+                    batch_timeout_ms, max_queue, warm, cache_mb,
+                    cache_ttl_s, tenants, min_replicas, max_replicas,
+                    autoscale_interval_s)
+            self.default_route = next(iter(self.entries))
+            self._server = _FrontendServer((host, port),
+                                           _FrontendHandler)
+        except Exception:
+            # a later entry (or the HTTP bind) failing must not leak
+            # the earlier entries' replica pools and batcher threads —
+            # they are already running and warmed, with no handle left
+            # for the caller to stop them
+            for entry in self.entries.values():
+                try:
+                    entry.stop()
+                except Exception:
+                    self.exception("entry %r cleanup failed",
+                                   entry.name)
+            raise
         self._server.frontend = self
         self.address = self._server.server_address
         self._thread = None
         self._reporter = None
-        # continuous SLO evaluation (p95 / queue-depth / shed-burn
-        # rules) — the series item 3's autoscaler will consume
+        # continuous SLO evaluation (p95 / queue-depth / shed-burn /
+        # cache-collapse / autoscale-flap rules — telemetry/alerts.py)
         from veles_tpu.telemetry import alerts
         alerts.get_engine().start()
 
@@ -117,50 +232,117 @@ class ServingFrontend(Logger):
     def port(self):
         return self.address[1]
 
+    # single-model accessors every PR 3 caller/test uses: the default
+    # entry IS the frontend when only one model is hosted
+
+    @property
+    def default_entry(self):
+        return self.entries[self.default_route]
+
     @property
     def model(self):
-        return self.pool.model
+        return self.default_entry.model
+
+    @property
+    def metrics(self):
+        return self.default_entry.metrics
+
+    @property
+    def pool(self):
+        return self.default_entry.pool
+
+    @property
+    def engine(self):
+        return self.default_entry.engine
+
+    @property
+    def cache(self):
+        return self.default_entry.cache
+
+    @property
+    def autoscaler(self):
+        return self.default_entry.autoscaler
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self):
+        for entry in self.entries.values():
+            if entry.autoscaler is not None:
+                entry.autoscaler.start()
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name="serving-http")
         self._thread.start()
-        self.info("serving %s v%d on %s:%d%s (%d replica(s), "
-                  "max batch %d)", self.model.name, self.model.version,
-                  self.address[0] or "0.0.0.0", self.port, self.path,
-                  len(self.pool.replicas), self.pool.max_batch_size)
+        for entry in self.entries.values():
+            self.info(
+                "serving %s v%d on %s:%d%s (%s replica(s), max batch "
+                "%d%s%s)", entry.model.name, entry.model.version,
+                self.address[0] or "0.0.0.0", self.port,
+                self._route_path(entry.name), entry.pool.size(),
+                entry.pool.max_batch_size,
+                ", cache %dMB" % (entry.cache.max_bytes >> 20)
+                if entry.cache else "",
+                ", autoscale [%d,%d]" % (
+                    entry.autoscaler.min_replicas,
+                    entry.autoscaler.max_replicas)
+                if entry.autoscaler else "")
         return self
+
+    def _route_path(self, route):
+        return self.path if route == self.default_route \
+            else "%s/%s" % (self.path, route)
 
     def stop(self):
         if self._reporter is not None:
             self._reporter.stop()
             self._reporter = None
-        self._server.shutdown()
+        if self._thread is not None:
+            # shutdown() blocks on serve_forever's exit handshake —
+            # calling it on a built-but-never-started frontend would
+            # hang forever
+            self._server.shutdown()
         self._server.server_close()
-        self.engine.stop()
-        self.pool.stop()
+        for entry in self.entries.values():
+            entry.stop()
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
 
     def swap_model(self, source, name=None, version=None):
-        """Load + register a new model version and promote the pool to
-        it (drain-old / promote-new, one replica at a time)."""
+        """Load + register a new model version and promote its entry
+        to it (drain-old / promote-new, one replica at a time), then
+        atomically invalidate that entry's result cache — no request
+        is ever answered with a stale cached result after this
+        returns."""
+        entry = self._entry_for(name)
         if isinstance(source, str):
-            model = self.store.load(source, name=name or self.model.name,
+            model = self.store.load(source, name=entry.name,
                                     version=version)
         else:
-            model = self.store.add(source, version=version)
-        if tuple(model.sample_shape) != tuple(self.model.sample_shape):
+            model = self.store.add(source, version=version,
+                                   name=entry.name)
+        if tuple(model.sample_shape) != tuple(entry.model.sample_shape):
             raise ValueError(
                 "refusing hot-swap: new sample shape %s != serving %s"
-                % (model.sample_shape, self.model.sample_shape))
-        self.pool.swap(model)
-        self.metrics.set_model(model.name, model.version)
+                % (model.sample_shape, entry.model.sample_shape))
+        entry.pool.swap(model)
+        if entry.cache is not None:
+            # AFTER the promotion: entries keyed by the old version
+            # can no longer be looked up (the version is in the key),
+            # and the epoch bump fences any in-flight insert computed
+            # against the drained model
+            entry.cache.invalidate()
+        entry.metrics.set_model(model.name, model.version)
         return model
+
+    def _entry_for(self, name):
+        if name is None:
+            return self.default_entry
+        entry = self.entries.get(name)
+        if entry is None:
+            raise KeyError("no model route %r (have %s)"
+                           % (name, sorted(self.entries)))
+        return entry
 
     def report_to(self, web_status_address, interval=2.0, name=None):
         """Push the metrics block to a web_status dashboard."""
@@ -177,7 +359,7 @@ class ServingFrontend(Logger):
         respond_json(handler, code, payload, headers=headers)
 
     def _fail(self, handler, endpoint, message, code=400, rid=None,
-              headers=None, t0=None):
+              headers=None, t0=None, entry=None):
         if code == 503:
             # expected shedding under overload — hundreds per second;
             # the rejected_total metric is the operator's signal
@@ -188,7 +370,7 @@ class ServingFrontend(Logger):
         if rid is not None:
             payload["id"] = rid
         self._respond(handler, code, payload, headers=headers)
-        self.metrics.record_request(
+        (entry or self.default_entry).metrics.record_request(
             endpoint, code,
             (time.time() - t0) * 1000.0 if t0 else None)
 
@@ -200,7 +382,11 @@ class ServingFrontend(Logger):
             from veles_tpu.telemetry import alerts
             self._respond(handler, 200, alerts.get_engine().report())
         elif handler.path.startswith("/metrics.json"):
-            self._respond(handler, 200, self.metrics.snapshot())
+            out = self.default_entry.snapshot()
+            if len(self.entries) > 1:
+                out["models"] = {name: entry.snapshot()
+                                 for name, entry in self.entries.items()}
+            self._respond(handler, 200, out)
         elif handler.path.startswith("/metrics"):
             body = get_registry().render_prometheus().encode("utf-8")
             handler.send_response(200)
@@ -213,9 +399,36 @@ class ServingFrontend(Logger):
             self._respond(handler, 200, {
                 "status": "ok", "model": self.model.name,
                 "version": self.model.version,
-                "sample_shape": list(self.model.sample_shape)})
+                "sample_shape": list(self.model.sample_shape),
+                "models": {
+                    name: {"name": entry.model.name,
+                           "version": entry.model.version,
+                           "replicas": entry.pool.size(),
+                           "path": self._route_path(name)}
+                    for name, entry in self.entries.items()}})
         else:
             self._respond(handler, 404, {"error": "not found"})
+
+    def _route(self, path):
+        """``(entry, endpoint, batched)`` for a POST path, or None."""
+        if not path.startswith(self.path):
+            return None
+        rest = path[len(self.path):]
+        if rest in ("", "/"):
+            return self.default_entry, self.path, False
+        if rest == "/batch":
+            return self.default_entry, self.path + "/batch", True
+        if not rest.startswith("/"):
+            return None         # /apialpha must not route to "alpha"
+        parts = rest.lstrip("/").split("/")
+        entry = self.entries.get(parts[0])
+        if entry is None:
+            return None
+        if len(parts) == 1:
+            return entry, self._route_path(parts[0]), False
+        if len(parts) == 2 and parts[1] == "batch":
+            return entry, self._route_path(parts[0]) + "/batch", True
+        return None
 
     def handle_post(self, handler):
         t0 = time.time()
@@ -235,26 +448,37 @@ class ServingFrontend(Logger):
             self._fail(handler, handler.path, "Invalid Content-Length",
                        t0=t0)
             return
-        if handler.path == self.path:
-            endpoint, batched = self.path, False
-        elif handler.path == self.path + "/batch":
-            endpoint, batched = self.path + "/batch", True
-        else:
+        routed = self._route(handler.path)
+        if routed is None:
             self._fail(handler, handler.path,
                        "API path %s is not supported" % handler.path,
                        code=404, t0=t0)
             return
+        entry, endpoint, batched = routed
         ctype = (handler.headers.get("Content-Type") or "").split(";")[0]
         if ctype.strip() != "application/json":
             self._fail(handler, endpoint, "Unsupported Content-Type "
-                       "(must be \"application/json\")", t0=t0)
+                       "(must be \"application/json\")", t0=t0,
+                       entry=entry)
             return
         try:
             request = json.loads(raw.decode("utf-8"))
         except (ValueError, UnicodeDecodeError):
-            self._fail(handler, endpoint, "Failed to parse JSON", t0=t0)
+            self._fail(handler, endpoint, "Failed to parse JSON", t0=t0,
+                       entry=entry)
             return
         rid = request.get("id") if isinstance(request, dict) else None
+        tenant = handler.headers.get(self.tenant_header) or \
+            (request.get("tenant") if isinstance(request, dict)
+             else None)
+        qos = handler.headers.get(self.qos_header) or \
+            (request.get("qos") if isinstance(request, dict) else None)
+        if qos is not None and qos not in QOS_MULTIPLIER:
+            self._fail(handler, endpoint,
+                       "Unknown QoS class %r (one of %s)"
+                       % (qos, sorted(QOS_MULTIPLIER)), rid=rid, t0=t0,
+                       entry=entry)
+            return
         # request-id → trace-id bridge: the span for this request (and
         # everything under it) carries the client's X-Request-Id / "id"
         trace_id = tracing.trace_id_from_request(handler.headers, rid)
@@ -262,41 +486,46 @@ class ServingFrontend(Logger):
             with tracing.request_span("http:%s" % endpoint,
                                       trace_id=trace_id):
                 if batched:
-                    self._serve_batch(handler, endpoint, request, rid, t0)
+                    self._serve_batch(handler, entry, endpoint, request,
+                                      rid, t0, tenant, qos)
                 else:
-                    self._serve_one(handler, endpoint, request, rid, t0)
+                    self._serve_one(handler, entry, endpoint, request,
+                                    rid, t0, tenant, qos)
         except EngineOverloaded as e:
             self._fail(handler, endpoint, str(e), code=503, rid=rid,
                        headers={"Retry-After": str(e.retry_after)},
-                       t0=t0)
+                       t0=t0, entry=entry)
 
-    def _serve_one(self, handler, endpoint, request, rid, t0):
+    def _serve_one(self, handler, entry, endpoint, request, rid, t0,
+                   tenant, qos):
         data, error = parse_payload(request)
         if error is not None:
-            self._fail(handler, endpoint, error, rid=rid, t0=t0)
+            self._fail(handler, endpoint, error, rid=rid, t0=t0,
+                       entry=entry)
             return
         try:
-            future = self.engine.submit(data)
+            future = entry.engine.submit(data, tenant=tenant, qos=qos)
         except ValueError as e:
             self._fail(handler, endpoint, "Invalid input value: %s" % e,
-                       rid=rid, t0=t0)
+                       rid=rid, t0=t0, entry=entry)
             return
-        self._await_and_reply(handler, endpoint, [future], rid, t0,
-                              single=True)
+        self._await_and_reply(handler, entry, endpoint, [future], rid,
+                              t0, single=True)
 
-    def _serve_batch(self, handler, endpoint, request, rid, t0):
+    def _serve_batch(self, handler, entry, endpoint, request, rid, t0,
+                     tenant, qos):
         if not isinstance(request, dict) or "codec" not in request or \
                 ("inputs" not in request and "input" not in request):
             self._fail(handler, endpoint, "Invalid input format: there "
                        "must be \"inputs\" and \"codec\" attributes",
-                       rid=rid, t0=t0)
+                       rid=rid, t0=t0, entry=entry)
             return
         if "inputs" in request:
             rows_spec = request["inputs"]
             if not isinstance(rows_spec, list) or not rows_spec:
                 self._fail(handler, endpoint,
                            "\"inputs\" must be a non-empty array",
-                           rid=rid, t0=t0)
+                           rid=rid, t0=t0, entry=entry)
                 return
             if request["codec"] == "list":
                 try:
@@ -305,7 +534,7 @@ class ServingFrontend(Logger):
                 except (TypeError, ValueError):
                     self._fail(handler, endpoint,
                                "Invalid input array format", rid=rid,
-                               t0=t0)
+                               t0=t0, entry=entry)
                     return
             else:
                 rows = []
@@ -314,31 +543,33 @@ class ServingFrontend(Logger):
                         dict(request, input=r, inputs=None))
                     if error is not None:
                         self._fail(handler, endpoint, error, rid=rid,
-                                   t0=t0)
+                                   t0=t0, entry=entry)
                         return
                     rows.append(data)
         else:
             # base64 with a leading batch dim in "shape"
             data, error = parse_payload(request)
             if error is not None:
-                self._fail(handler, endpoint, error, rid=rid, t0=t0)
+                self._fail(handler, endpoint, error, rid=rid, t0=t0,
+                           entry=entry)
                 return
             rows = list(data)
         futures = []
         try:
             for row in rows:
-                futures.append(self.engine.submit(row))
+                futures.append(entry.engine.submit(row, tenant=tenant,
+                                                   qos=qos))
         except ValueError as e:
             # rows already admitted still complete; their results are
             # simply dropped with the failed request
             self._fail(handler, endpoint, "Invalid input value: %s" % e,
-                       rid=rid, t0=t0)
+                       rid=rid, t0=t0, entry=entry)
             return
-        self._await_and_reply(handler, endpoint, futures, rid, t0,
-                              single=False)
+        self._await_and_reply(handler, entry, endpoint, futures, rid,
+                              t0, single=False)
 
-    def _await_and_reply(self, handler, endpoint, futures, rid, t0,
-                         single):
+    def _await_and_reply(self, handler, entry, endpoint, futures, rid,
+                         t0, single):
         try:
             deadline = t0 + self.response_timeout
             results = [f.result(timeout=max(deadline - time.time(),
@@ -347,14 +578,14 @@ class ServingFrontend(Logger):
         except concurrent.futures.TimeoutError:
             self._fail(handler, endpoint,
                        "The model did not respond in time", code=500,
-                       rid=rid, t0=t0)
+                       rid=rid, t0=t0, entry=entry)
             return
         except EngineOverloaded:
             raise
         except Exception as e:
             self._fail(handler, endpoint, "inference failed: %s"
                        % (str(e) or type(e).__name__), code=500,
-                       rid=rid, t0=t0)
+                       rid=rid, t0=t0, entry=entry)
             return
         if single:
             payload = {"result": results[0]}
@@ -363,8 +594,8 @@ class ServingFrontend(Logger):
         if rid is not None:
             payload["id"] = rid
         self._respond(handler, 200, payload)
-        self.metrics.record_request(endpoint, 200,
-                                    (time.time() - t0) * 1000.0)
+        entry.metrics.record_request(endpoint, 200,
+                                     (time.time() - t0) * 1000.0)
 
 
 class _StatusReporter(Logger):
@@ -398,7 +629,8 @@ class _StatusReporter(Logger):
             "mode": "serve",
             "master": self.frontend.address[0] or "localhost",
             "time": time.time() - self._started,
-            "units": len(self.frontend.pool.replicas),
+            "units": sum(e.pool.size()
+                         for e in self.frontend.entries.values()),
             "stopped": False,
             "serving": self.frontend.metrics.dashboard_block(),
         }
@@ -426,25 +658,88 @@ class _StatusReporter(Logger):
             self._thread = None
 
 
+def _parse_tenants(specs):
+    """``name:weight[:qos]`` flags -> the AdmissionController map."""
+    tenants = {}
+    for spec in specs or ():
+        parts = spec.split(":")
+        if not parts[0]:
+            raise ValueError("tenant spec %r needs a name" % spec)
+        entry = {"weight": float(parts[1]) if len(parts) > 1 else 1.0}
+        if len(parts) > 2:
+            if parts[2] not in QOS_MULTIPLIER:
+                raise ValueError(
+                    "tenant spec %r: unknown QoS %r (one of %s)"
+                    % (spec, parts[2], sorted(QOS_MULTIPLIER)))
+            entry["qos"] = parts[2]
+        tenants[parts[0]] = entry
+    return tenants or None
+
+
+def _parse_models(specs):
+    """``[name=]path`` flags -> the ServingFrontend model dict."""
+    models = {}
+    for spec in specs:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            name, path = None, spec
+        if name in models:
+            # dict insertion would silently drop one artifact —
+            # either an unnamed repeat or a name= typo
+            if name is None:
+                raise ValueError("with multiple --model flags, every "
+                                 "one needs a name= prefix")
+            raise ValueError("duplicate model route %r (--model %s)"
+                             % (name, spec))
+        models[name] = path
+    if len(models) == 1:
+        name, path = next(iter(models.items()))
+        return path if name is None else {name: path}
+    if None in models:
+        raise ValueError("with multiple --model flags, every one "
+                         "needs a name= prefix")
+    return models
+
+
 def main(argv=None):
     """``python -m veles_tpu serve ...`` / ``veles-tpu-serve``."""
     parser = argparse.ArgumentParser(
         prog="veles_tpu serve",
         description="dynamic-batching inference server")
-    parser.add_argument("--model", required=True,
-                        help="snapshot file/dir/URI or export package")
+    parser.add_argument("--model", required=True, action="append",
+                        help="snapshot file/dir/URI or export package; "
+                             "repeat with name=path to serve several "
+                             "models from one process")
     parser.add_argument("--name", default=None,
                         help="model name in the store (default: from "
-                             "the artifact)")
+                             "the artifact; single --model only)")
     parser.add_argument("--host", default="")
     parser.add_argument("--port", type=int, default=8180)
     parser.add_argument("--path", default=root.common.api.path)
-    parser.add_argument("--replicas", type=int, default=1)
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="initial replica-pool size per model")
+    parser.add_argument("--min-replicas", type=int, default=None,
+                        help="autoscaler floor (default: --replicas)")
+    parser.add_argument("--max-replicas", type=int, default=None,
+                        help="autoscaler ceiling; setting it ENABLES "
+                             "telemetry-driven autoscaling")
     parser.add_argument("--max-batch-size", type=int, default=64)
     parser.add_argument("--batch-timeout-ms", type=float, default=5.0)
     parser.add_argument("--max-queue", type=int, default=256,
                         help="admission bound; beyond it requests get "
                              "503 + Retry-After")
+    parser.add_argument("--cache-mb", type=float, default=64.0,
+                        help="result-cache byte budget per model "
+                             "(0 disables the cache)")
+    parser.add_argument("--cache-ttl-s", type=float, default=300.0)
+    parser.add_argument("--tenant", action="append", metavar="SPEC",
+                        help="name:weight[:qos] — pre-register a "
+                             "tenant admission bucket (qos one of "
+                             "interactive/batch/best_effort); repeat "
+                             "per tenant")
+    parser.add_argument("--keep-last", type=int, default=None,
+                        help="retain at most K versions per model in "
+                             "the store (pinned exempt)")
     parser.add_argument("--response-timeout", type=float, default=30.0)
     parser.add_argument("--web-status", default=None, metavar="HOST:PORT",
                         help="push serving metrics to this dashboard")
@@ -468,14 +763,18 @@ def main(argv=None):
             pass
     from veles_tpu.telemetry import profiler
     profiler.start_memory_sampler()
-    store = ModelStore()
-    model = store.load(args.model, name=args.name)
+    models = _parse_models(args.model)
+    if args.name and isinstance(models, str):
+        models = {args.name: models}
     frontend = ServingFrontend(
-        model, host=args.host, port=args.port, path=args.path,
+        models, host=args.host, port=args.port, path=args.path,
         replicas=args.replicas, max_batch_size=args.max_batch_size,
         batch_timeout_ms=args.batch_timeout_ms, max_queue=args.max_queue,
-        response_timeout=args.response_timeout)
-    frontend.store = store
+        response_timeout=args.response_timeout,
+        cache_mb=args.cache_mb, cache_ttl_s=args.cache_ttl_s,
+        tenants=_parse_tenants(args.tenant),
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        keep_last=args.keep_last)
     if args.web_status:
         frontend.report_to(args.web_status)
     frontend.start()

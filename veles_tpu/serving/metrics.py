@@ -70,20 +70,23 @@ class ServingMetrics(object):
     """Shared, thread-safe metrics hub for one serving process."""
 
     def __init__(self, reservoir_size=4096, qps_window=10.0,
-                 registry=None):
+                 registry=None, model_label="default"):
         self._lock = threading.Lock()
         self._reservoir_size = reservoir_size
         self._qps_window = qps_window
         self._endpoints = {}
         self._rejected = 0          # admission-control 503s
+        self._cached = 0            # requests answered from the cache
         self._batches = 0
         self._batch_rows = 0
         self._batch_capacity = 0    # sum of bucket sizes actually run
         self._occupancy = collections.deque(maxlen=reservoir_size)
         self._queue_depth_fn = None
         self._replica_stats_fn = None
+        self._cache_stats_fn = None
         self._started = time.time()
         self._model = {}
+        self.model_label = str(model_label)
         # mirror into the process-wide registry (Prometheus /metrics)
         registry = registry or get_registry()
         self._m_requests = registry.counter(
@@ -92,20 +95,30 @@ class ServingMetrics(object):
         self._m_latency = registry.histogram(
             "veles_serving_latency_ms", "End-to-end request latency",
             labels=("endpoint",), reservoir_size=reservoir_size)
+        # engine-side families carry the model label: one ServingMetrics
+        # per hosted model would otherwise merge its series with every
+        # other model's (the endpoint-labeled families above are
+        # already distinguished by their per-route paths)
+        label = {"model": self.model_label}
         self._m_rejected = registry.counter(
             "veles_serving_rejected_total",
-            "Requests shed by admission control (503)")
+            "Requests shed by admission control (503)",
+            labels=("model",)).labels(**label)
         self._m_batches = registry.counter(
-            "veles_serving_batches_total", "Engine batches run")
+            "veles_serving_batches_total", "Engine batches run",
+            labels=("model",)).labels(**label)
         self._m_batch_rows = registry.counter(
-            "veles_serving_batch_rows_total", "Real samples batched")
+            "veles_serving_batch_rows_total", "Real samples batched",
+            labels=("model",)).labels(**label)
         self._m_occupancy = registry.histogram(
             "veles_serving_batch_occupancy",
             "Real rows / compiled bucket size per batch",
-            reservoir_size=reservoir_size)
+            labels=("model",),
+            reservoir_size=reservoir_size).labels(**label)
         self._m_queue_depth = registry.gauge(
             "veles_serving_queue_depth",
-            "Live admission-queue depth (refreshed on snapshot)")
+            "Live admission-queue depth (refreshed on snapshot)",
+            labels=("model",)).labels(model=self.model_label)
 
     # -- wiring ------------------------------------------------------------
 
@@ -116,6 +129,15 @@ class ServingMetrics(object):
     def attach_replica_stats(self, fn):
         """``fn() -> list of per-replica dicts`` (see ReplicaPool)."""
         self._replica_stats_fn = fn
+
+    def attach_cache_stats(self, fn):
+        """``fn() -> dict`` (see :class:`ResultCache.stats`)."""
+        self._cache_stats_fn = fn
+
+    def record_cache_hit(self):
+        """A request was answered from the result cache (no batch)."""
+        with self._lock:
+            self._cached += 1
 
     def set_model(self, name, version):
         with self._lock:
@@ -170,6 +192,7 @@ class ServingMetrics(object):
                 "model": dict(self._model),
                 "qps": total_qps,
                 "rejected_total": self._rejected,
+                "cached_total": self._cached,
                 "endpoints": per_endpoint,
                 "batches": {
                     "count": self._batches,
@@ -190,6 +213,8 @@ class ServingMetrics(object):
         self._m_queue_depth.set(out["queue_depth"])
         if self._replica_stats_fn is not None:
             out["replicas"] = self._replica_stats_fn()
+        if self._cache_stats_fn is not None:
+            out["cache"] = self._cache_stats_fn()
         return out
 
     def dashboard_block(self):

@@ -231,19 +231,33 @@ def _is_package(path):
 
 
 class ModelStore(Logger):
-    """Named, versioned serveable models with pinning.
+    """Named, versioned serveable models with pinning and retention.
 
     ``load()`` auto-detects the artifact kind; versions count up per
     name. ``get(name)`` returns the pinned version if one is set, else
     the newest — the replica pool promotes whatever ``get`` returns, so
     pin-then-swap is the rollback procedure (``docs/SERVING.md``).
+
+    **Disk hygiene** (ISSUE 14): a long-running multi-model server
+    swaps new versions in for months — without retention every retired
+    version's weights stay resident and every snapshot file it was
+    loaded from stays on disk. ``keep_last=K`` bounds each name to its
+    newest K versions: on ``add()``, older *unpinned* versions are
+    retired from memory, and with ``prune_disk=True`` their source
+    snapshot **files** are deleted too (only plain local files the
+    store itself loaded — never directories, URIs, packages, or a
+    source another retained version still references). Pinned versions
+    are exempt: a pin is the operator's rollback anchor and outlives
+    any retention sweep.
     """
 
-    def __init__(self):
+    def __init__(self, keep_last=None, prune_disk=False):
         super(ModelStore, self).__init__()
         self._lock = threading.Lock()
         self._models = {}   # name -> {version: ServeableModel}
         self._pins = {}     # name -> version
+        self.keep_last = int(keep_last) if keep_last else None
+        self.prune_disk = bool(prune_disk)
 
     def load(self, source, name=None, version=None):
         """Load an artifact and register it; returns the model.
@@ -251,27 +265,95 @@ class ModelStore(Logger):
         ``source`` may be an export package (dir / ``.tar`` holding
         ``contents.json``), a snapshot file or URI, or a snapshot
         *directory* (the newest snapshot inside is taken — the shape
-        ``SnapshotterToFile`` leaves behind)."""
+        ``SnapshotterToFile`` leaves behind). A corrupt newest entry
+        in a snapshot directory (crash mid-copy, torn rsync) is
+        skipped with a warning and the next-newest loadable snapshot
+        serves instead — a serving restart must come up with the best
+        artifact that actually loads, mirroring the trainer's
+        auto-resume discipline (``snapshotter.restore_latest``)."""
         path = str(source)
         if _is_package(path):
             model = ServeableModel.from_package(path, name=name)
+        elif os.path.isdir(path):
+            model = self._load_from_snapshot_dir(path, name)
         else:
-            if os.path.isdir(path):
-                from veles_tpu.snapshotter import latest_snapshot
-                path = latest_snapshot(path)
             model = ServeableModel.from_snapshot(path, name=name)
         return self.add(model, version=version)
 
-    def add(self, model, version=None):
+    def _load_from_snapshot_dir(self, path, name):
+        from veles_tpu.snapshotter import snapshot_candidates
+        candidates = snapshot_candidates(path)
+        if not candidates:
+            raise ModelLoadError("no snapshots under %s" % path)
+        last_error = None
+        for candidate in candidates:
+            try:
+                return ServeableModel.from_snapshot(candidate,
+                                                    name=name)
+            except Exception as e:
+                last_error = e
+                self.warning("skipping corrupt/unloadable snapshot "
+                             "%s: %s", candidate, e)
+        raise ModelLoadError(
+            "no loadable snapshot under %s (newest error: %s)" %
+            (path, last_error))
+
+    def add(self, model, version=None, name=None):
+        """Register under ``name`` (default: the model's own name).
+        A serving route passes its route name so two routes hosting
+        variants that share a model name never overwrite each other's
+        version maps — the model object itself is not renamed."""
         with self._lock:
-            versions = self._models.setdefault(model.name, {})
+            key = name or model.name
+            versions = self._models.setdefault(key, {})
             if version is None:
                 version = max(versions, default=0) + 1
             model.version = int(version)
             versions[model.version] = model
-        self.info("registered %s v%d (from %s)", model.name,
+            retired = self._retire_locked(key)
+        self.info("registered %s v%d (from %s)", key,
                   model.version, model.source)
+        for old in retired:
+            self._prune_source(old)
         return model
+
+    def _retire_locked(self, name):
+        """Drop the oldest unpinned versions beyond ``keep_last``."""
+        if not self.keep_last:
+            return []
+        versions = self._models.get(name, {})
+        pinned = self._pins.get(name)
+        retired = []
+        for v in sorted(versions):
+            if len(versions) <= self.keep_last:
+                break
+            if v == pinned or v == max(versions):
+                continue                # pinned + newest are exempt
+            retired.append(versions.pop(v))
+        return retired
+
+    def _prune_source(self, model):
+        """Delete a retired version's snapshot FILE, conservatively."""
+        self.info("retired %s v%d (keep_last=%d)", model.name,
+                  model.version, self.keep_last)
+        if not self.prune_disk:
+            return
+        source = model.source
+        if not source or not os.path.isfile(source) or \
+                _is_package(source):
+            return                      # only plain local snapshot files
+        with self._lock:
+            still_used = any(
+                m.source == source
+                for versions in self._models.values()
+                for m in versions.values())
+        if still_used:
+            return
+        try:
+            os.remove(source)
+            self.info("pruned retired snapshot %s", source)
+        except OSError as e:
+            self.warning("could not prune %s: %s", source, e)
 
     def get(self, name=None, version=None):
         with self._lock:

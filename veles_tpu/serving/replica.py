@@ -18,10 +18,22 @@ work, and :meth:`ReplicaPool.swap` hot-swaps the model: the swap rides
 the same work queue as inference, so each replica drains everything
 already accepted, swaps, re-warms, and only then takes new work — no
 request ever observes a half-swapped replica.
+
+The pool is **elastic** (ISSUE 14): :meth:`ReplicaPool.add_replica`
+grows it under fire (the new replica warms its buckets BEFORE joining
+dispatch, so scale-up never routes traffic onto a cold JIT cache) and
+:meth:`ReplicaPool.remove_replica` shrinks it by removing a replica
+from dispatch first and only then draining what it already accepted —
+zero in-flight requests die on a scale-down. Warm-up H2D rides the
+PR 8 :class:`~veles_tpu.loader.prefetch.StagingRing` (bounded device
+residency during the bucket sweep) and is recorded as the
+``veles_phase_ms{phase="replica_warmup"}`` startup gauge — the
+serving half of ROADMAP item 4's cold-start hunt.
 """
 
 import queue
 import threading
+import time
 
 import numpy
 
@@ -71,6 +83,7 @@ class Replica(Logger):
         self._queue = queue.Queue()
         self._pending = 0           # queued + running rows, approx load
         self._pending_lock = threading.Lock()
+        self._retired = False       # out of dispatch, refusing batches
         self.batches_done = 0
         self.rows_done = 0
         self._stop = threading.Event()
@@ -91,23 +104,40 @@ class Replica(Logger):
             self.warm()
 
     def warm(self):
-        """Compile every batch bucket ahead of traffic."""
+        """Compile every batch bucket ahead of traffic.
+
+        The warm-up batches reach the device through the input
+        pipeline's :class:`~veles_tpu.loader.prefetch.StagingRing`
+        (the same H2D path streamed training shards ride): at most
+        two buckets are device-resident during the sweep instead of
+        every bucket's zeros accumulating, and on real accelerators
+        the placement overlaps the previous bucket's compile. The
+        sweep is the ``replica_warmup`` startup phase — scale-up cost
+        is measured, not guessed."""
+        from veles_tpu.loader.prefetch import warmup_ring
         from veles_tpu.telemetry import profiler
         book = profiler.get_cost_book()
-        with profiler.phase("warmup"):
-            for bucket in buckets_upto(self.max_batch_size):
-                x = numpy.zeros((bucket,) + self.model.sample_shape,
-                                numpy.float32)
-                numpy.asarray(self._forward(x))  # force compile + execute
-                # cost harvest AFTER the warming call: its compile
-                # populated the persistent XLA cache, so the harvest's
-                # lower().compile() deserializes instead of paying a
-                # second full compile — and the roofline table then
-                # covers every serving bucket alongside the train
-                # segments
-                book.harvest("serve_forward:b%d" % bucket,
-                             self._forward, (x,))
-                self.warmed_buckets.append(bucket)
+        ring = warmup_ring()
+        try:
+            with profiler.phase("replica_warmup"):
+                for bucket in buckets_upto(self.max_batch_size):
+                    x = numpy.zeros(
+                        (bucket,) + self.model.sample_shape,
+                        numpy.float32)
+                    staged, = ring.place((x,))
+                    # force compile + execute
+                    numpy.asarray(self._forward(staged))
+                    # cost harvest AFTER the warming call: its compile
+                    # populated the persistent XLA cache, so the
+                    # harvest's lower().compile() deserializes instead
+                    # of paying a second full compile — and the
+                    # roofline table then covers every serving bucket
+                    # alongside the train segments
+                    book.harvest("serve_forward:b%d" % bucket,
+                                 self._forward, (x,))
+                    self.warmed_buckets.append(bucket)
+        finally:
+            ring.clear()
         self.debug("replica %d warm: %s v%d, buckets %s", self.index,
                    self.model.name, self.model.version,
                    self.warmed_buckets)
@@ -134,15 +164,33 @@ class Replica(Logger):
 
     def submit(self, batch, on_done):
         """Queue a batch; ``on_done(result_rows, bucket, error)`` fires
-        on the worker thread."""
+        on the worker thread. Returns False (nothing queued) once the
+        replica is retired — the check shares the load-accounting lock,
+        so a True return guarantees :meth:`wait_drained` sees the
+        batch."""
         with self._pending_lock:
+            if self._retired:
+                return False
             self._pending += int(batch.shape[0])
         self._queue.put((batch, on_done))
+        return True
+
+    def retire(self, retired=True):
+        """Mark the replica as leaving dispatch: subsequent
+        :meth:`submit` calls are refused, so a drain that observed an
+        empty queue cannot be invalidated by a late batch."""
+        with self._pending_lock:
+            self._retired = retired
 
     def swap(self, model):
         """Queue a drain-then-swap; returns an event set when done."""
         op = _Swap(model)
         with self._pending_lock:
+            if self._retired:
+                # leaving the pool anyway: promoting would only delay
+                # the drain, and the queue may already be dead
+                op.done.set()
+                return op.done
             self._pending += self.SWAP_LOAD
         self._queue.put(op)
         return op.done
@@ -181,6 +229,17 @@ class Replica(Logger):
             self.rows_done += int(batch.shape[0])
             on_done(result, bucket, error)
 
+    def wait_drained(self, timeout=60.0):
+        """Block until everything this replica accepted has been
+        answered (load 0, queue empty). Callers must have removed the
+        replica from dispatch first, or the drain never converges."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.load == 0 and self._queue.empty():
+                return True
+            time.sleep(0.005)
+        return self.load == 0 and self._queue.empty()
+
     def stop(self):
         self._stop.set()
         self._queue.put(None)
@@ -207,7 +266,8 @@ class Replica(Logger):
 
 
 class ReplicaPool(Logger):
-    """N replicas of one model; least-loaded dispatch; atomic swap."""
+    """Elastic replica set: least-loaded dispatch, atomic swap,
+    grow/shrink under live traffic."""
 
     def __init__(self, model, n_replicas=1, max_batch_size=64,
                  warm=True):
@@ -215,14 +275,16 @@ class ReplicaPool(Logger):
         self.max_batch_size = int(max_batch_size)
         self._dispatch_lock = threading.Lock()
         self._rr = 0
-        self.replicas = [
-            Replica(model, index=i, max_batch_size=max_batch_size,
-                    warm=warm)
-            for i in range(max(1, int(n_replicas)))]
+        self._warm = bool(warm)
+        self._next_index = 0
+        self._model = model
+        self.replicas = []
+        for _ in range(max(1, int(n_replicas))):
+            self.add_replica()
 
     @property
     def model(self):
-        return self.replicas[0].model
+        return self._model
 
     def pick(self):
         """Least-loaded replica; round-robin breaks ties so idle
@@ -237,16 +299,100 @@ class ReplicaPool(Logger):
         """True when some replica has no queued/running work — the
         batcher's dispatch gate: while every replica is busy, a forming
         batch keeps growing instead of queueing up small fragments."""
-        return any(r.load == 0 for r in self.replicas)
+        with self._dispatch_lock:
+            replicas = list(self.replicas)
+        return any(r.load == 0 for r in replicas)
 
     def submit(self, batch, on_done):
-        self.pick().submit(batch, on_done)
+        # pick() releases the dispatch lock before the replica accepts
+        # the batch, so the picked replica may retire (scale-down)
+        # in between — it refuses atomically and the batch is simply
+        # re-picked; by then the victim has left the dispatch list
+        while not self.pick().submit(batch, on_done):
+            pass
+
+    # -- elasticity --------------------------------------------------------
+
+    def size(self):
+        with self._dispatch_lock:
+            return len(self.replicas)
+
+    def add_replica(self):
+        """Grow the pool by one warm replica. The replica compiles and
+        warms every bucket BEFORE it enters the dispatch list, so
+        scale-up traffic never lands on a cold JIT cache — the warm-up
+        cost lands in ``veles_phase_ms{phase="replica_warmup"}``, not
+        in some unlucky client's tail."""
+        with self._dispatch_lock:
+            index = self._next_index
+            self._next_index += 1
+            current = self._model
+        replica = Replica(current, index=index,
+                          max_batch_size=self.max_batch_size,
+                          warm=self._warm)
+        while True:
+            with self._dispatch_lock:
+                if replica.model is self._model:
+                    self.replicas.append(replica)
+                    n = len(self.replicas)
+                    break
+                # swap() promoted the pool while this replica spent
+                # seconds warming against the OLD version — joining
+                # dispatch now would serve stale results (and poison
+                # the cache under the new version's keys) forever
+                current = self._model
+            replica.swap(current).wait(120)
+        self.info("pool grew to %d replica(s) (+ replica %d)", n, index)
+        return replica
+
+    def remove_replica(self, timeout=60.0):
+        """Shrink by one: the victim leaves the dispatch list FIRST
+        (new batches can no longer route to it), then drains whatever
+        it already accepted, then stops — zero in-flight requests die.
+        The last replica is never removed. Returns the drained replica
+        or None when the pool is already at one."""
+        with self._dispatch_lock:
+            if len(self.replicas) <= 1:
+                return None
+            # take the least-loaded: the shortest drain, so capacity
+            # recovers to the target fastest
+            victim = min(self.replicas, key=lambda r: r.load)
+            self.replicas.remove(victim)
+            n = len(self.replicas)
+        # refuse batches from a concurrent submit() that picked the
+        # victim before it left the list — without this, a batch can
+        # land AFTER the drain check and strand its futures forever
+        victim.retire()
+        if not victim.wait_drained(timeout):
+            # drain stalled (wedged forward): put it back rather than
+            # kill requests — the autoscaler retries next tick
+            self.warning("replica %d did not drain in %.0fs; "
+                         "returning it to dispatch", victim.index,
+                         timeout)
+            victim.retire(False)
+            with self._dispatch_lock:
+                self.replicas.append(victim)
+            return None
+        victim.stop()
+        self.info("pool shrank to %d replica(s) (- replica %d)", n,
+                  victim.index)
+        return victim
+
+    # -- swap / stats / lifecycle ------------------------------------------
 
     def swap(self, model, timeout=120.0):
         """Hot-swap every replica, one at a time: each drains its
         accepted work, promotes, re-warms, and rejoins dispatch while
-        the others keep serving — capacity dips by 1/N, never to 0."""
-        for replica in self.replicas:
+        the others keep serving — capacity dips by 1/N, never to 0.
+        A replica added concurrently (autoscaler) re-checks the pool
+        model under the dispatch lock before joining, so setting
+        ``_model`` and snapshotting the list in ONE critical section
+        guarantees every replica is either in this snapshot (promoted
+        here) or promotes itself before dispatch."""
+        with self._dispatch_lock:
+            self._model = model
+            replicas = list(self.replicas)
+        for replica in replicas:
             done = replica.swap(model)
             if not done.wait(timeout):
                 raise TimeoutError(
@@ -255,8 +401,13 @@ class ReplicaPool(Logger):
         self.info("pool promoted to %s v%d", model.name, model.version)
 
     def stats(self):
-        return [r.stats() for r in self.replicas]
+        with self._dispatch_lock:
+            replicas = list(self.replicas)
+        return [r.stats() for r in replicas]
 
     def stop(self):
-        for replica in self.replicas:
+        with self._dispatch_lock:
+            replicas = list(self.replicas)
+            self.replicas = []
+        for replica in replicas:
             replica.stop()

@@ -186,6 +186,27 @@ DEFAULT_RULES = (
      "objective": 0.01, "windows": [[60.0, 14.4], [300.0, 6.0]],
      "severity": "critical",
      "description": "shedding >1% of requests at multi-window burn"},
+    {"name": "serving_cache_collapse",
+     "metric": "veles_serving_cache_hit_ratio", "agg": "min",
+     "op": "<", "threshold": 0.05, "for_s": 30.0, "clear_for_s": 30.0,
+     "description": "result-cache hit ratio collapsed (<5% over the "
+                    "recent lookup window) — an invalidation storm or "
+                    "a traffic shift away from repeats; the gauge only "
+                    "publishes once the window is mature, so an idle "
+                    "or cache-less server never fires this"},
+    {"name": "autoscale_flap", "kind": "increase",
+     "metric": "veles_autoscale_transitions_total", "window_s": 60.0,
+     "threshold": 4.0, "clear_for_s": 120.0,
+     "description": "5+ replica scale transitions within a minute — "
+                    "the hysteresis/cooldown settings are too tight "
+                    "for this traffic shape"},
+    {"name": "tenant_shed_burn",
+     "metric": "veles_serving_tenant_shed_ratio", "agg": "max",
+     "op": ">", "threshold": 0.5, "for_s": 10.0, "clear_for_s": 30.0,
+     "severity": "critical",
+     "description": "some tenant is shedding over half of its recent "
+                    "requests — its share is exhausted (raise its "
+                    "weight, or its clients must back off)"},
     {"name": "input_starvation",
      "metric": "veles_input_starvation_fraction", "agg": "max",
      "op": ">", "threshold": 0.5, "for_s": 15.0, "clear_for_s": 15.0,

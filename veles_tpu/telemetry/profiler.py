@@ -310,7 +310,8 @@ class timed_op(object):
 # -- startup phases ----------------------------------------------------------
 
 PHASES = ("dataset_generate", "dataset_load", "autotune_load",
-          "compile", "warmup", "pipeline_fill", "first_step")
+          "compile", "warmup", "replica_warmup", "pipeline_fill",
+          "first_step")
 
 _phase_lock = threading.Lock()
 _phase_ms = {}  # phase -> cumulative ms this process
