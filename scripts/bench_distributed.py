@@ -67,10 +67,14 @@ def _build(launcher):
     if CONFIG == "fc":
         from veles_tpu.datasets import golden_digits
         from veles_tpu.models.mnist import MnistWorkflow
+        # VELES_DIST_MB: the GSPMD e2e pair overrides the minibatch to
+        # one the 8-way batch axis divides (512); both of its legs use
+        # the same value so the comparison stays fair
+        mb = int(os.environ.get("VELES_DIST_MB", "0") or 0) or 500
         return MnistWorkflow(
             launcher, provider=golden_digits(n_train=12000,
                                              n_valid=500),
-            layers=(100,), minibatch_size=500, max_epochs=EPOCHS)
+            layers=(100,), minibatch_size=mb, max_epochs=EPOCHS)
     if CONFIG == "smallconv":
         from veles_tpu.models.alexnet import (AlexNetWorkflow,
                                               SyntheticImageLoader,
@@ -312,6 +316,19 @@ def run_slave(port):
     print(json.dumps({"leg": "slave", "ok": True}))
 
 
+def _payload_shrink():
+    """``VELES_DIST_PAYLOAD_SHRINK``: divide the large fc dims of the
+    exchange payload by this factor (CI quick mode — the flagship
+    249.5 MB set stacked 8-wide for the GSPMD merge leg would not fit
+    a shared runner). Both the shm and the GSPMD legs read it, so the
+    compared cycles always carry the SAME payload."""
+    try:
+        return max(1, int(os.environ.get("VELES_DIST_PAYLOAD_SHRINK",
+                                         "1")))
+    except ValueError:
+        return 1
+
+
 def _alexnet_payload(rng, scale=1.0):
     """The real AlexNet-227 stored parameter set (conv kernels + fc
     trunk), f32; conv1 is (ky, kx, 3, 96) — the s2d regrouping happens
@@ -321,6 +338,10 @@ def _alexnet_payload(rng, scale=1.0):
               (3, 3, 256, 384), (384,), (3, 3, 384, 384), (384,),
               (3, 3, 384, 256), (256,), (9216, 4096), (4096,),
               (4096, 4096), (4096,), (4096, 1000), (1000,)]
+    shrink = _payload_shrink()
+    if shrink > 1:
+        shapes = [tuple(d // shrink if d >= 1024 else d for d in s)
+                  for s in shapes]
     return {"w%d" % i: (rng.randn(*s) * scale).astype(numpy.float32)
             for i, s in enumerate(shapes)}
 
@@ -450,6 +471,120 @@ def run_shmbench():
             "mb_per_s": round(total_mb / cyc, 0),
             "speedup_vs_pickle": round(base / cyc, 2)}
     print(json.dumps(report))
+
+
+def run_gspmd_merge():
+    """The GSPMD gradient-merge cycle at exchange-payload scale
+    (ISSUE 15): the same parameter set ``shmbench`` pushes through the
+    PR 2 shm wire, but merged the launcher-SPMD way — every device of
+    the 8-way CPU mesh holds its own full-size partial gradient (the
+    per-slave delta of the coordinator protocol) and ONE jitted
+    reduction, partitioned over the named ``batch`` axis, merges them
+    with a compiler-inserted all-reduce. No pickling, no memcpy, no
+    decode: the whole "exchange" is the collective. Reports the
+    best-of-N blocked cycle plus the compiled program's
+    collective-bytes estimate (the ISSUE 15 CostBook surface).
+
+    Run under ``XLA_FLAGS=--xla_force_host_platform_device_count=8``
+    (the orchestrator forces it); numbers on a CPU mesh measure the
+    machinery's overhead honestly — all 8 "devices" share the same
+    cores — while on a real pod the same program rides ICI."""
+    import numpy
+
+    import jax
+    import jax.numpy as jnp
+
+    from veles_tpu.parallel.gspmd import BATCH_AXIS, gspmd_mesh
+    from veles_tpu.parallel.mesh import named_sharding
+    from veles_tpu.telemetry import profiler
+
+    cycles = int(os.environ.get("VELES_SHMBENCH_CYCLES", 5))
+    mesh = gspmd_mesh()
+    n_dev = mesh.shape[BATCH_AXIS]
+    rng = numpy.random.RandomState(0)
+    payload = _alexnet_payload(rng, scale=0.001)
+    total_mb = sum(a.nbytes for a in payload.values()) / 1e6
+    part_spec = named_sharding(mesh, BATCH_AXIS)
+    repl = named_sharding(mesh)
+
+    def put_stacked(arr):
+        # each device's shard of the stacked dim IS its local partial
+        # gradient — a zero-copy broadcast view feeds the per-shard
+        # slices, so host memory holds ONE copy however wide the mesh
+        stacked = numpy.broadcast_to(arr, (n_dev,) + arr.shape)
+        return jax.device_put(stacked, part_spec)
+
+    parts = {k: put_stacked(v) for k, v in payload.items()}
+
+    def merge(tree):
+        return {k: jnp.sum(v, axis=0) for k, v in tree.items()}
+
+    jit_merge = jax.jit(merge, out_shardings=repl)
+    jax.block_until_ready(jit_merge(parts))  # compile outside the clock
+    best = None
+    for _ in range(cycles):
+        t0 = time.time()
+        jax.block_until_ready(jit_merge(parts))
+        dt = time.time() - t0
+        best = dt if best is None or dt < best else best
+    coll = profiler.collective_bytes_estimate(
+        jit_merge.lower(parts).compile()) or {}
+    print(json.dumps({
+        "leg": "gspmd_merge", "payload_mb": round(total_mb, 1),
+        "devices": n_dev, "cycles": cycles,
+        "full_cycle_s": round(best, 4),
+        "mb_per_s": round(total_mb / best, 0),
+        "collective_bytes_mb": round(coll.get("bytes", 0) / 1e6, 1),
+        "collectives": coll.get("count", 0)}))
+
+
+def orchestrate_gspmd():
+    """``--gspmd`` (ISSUE 15): the exchange/merge-cycle comparison —
+    the PR 2 shm wire codecs vs the compiler-inserted collective on
+    the forced-8-device CPU mesh, same payload — plus (unless
+    ``VELES_GSPMD_E2E=0``) an end-to-end standalone-vs-GSPMD training
+    pair on the FC config so the whole launcher path stays exercised."""
+    shrink = _payload_shrink()
+    shm = _drain(_spawn("shmbench", tpu=False), "shmbench")
+    merge = _drain(_spawn(
+        "gspmd-merge", tpu=False,
+        extra_env={"XLA_FLAGS":
+                   "--xla_force_host_platform_device_count=8"}),
+        "gspmd-merge")
+    oob_s = shm["oob"]["full_cycle_s"]
+    pickle_s = shm["pickle"]["full_cycle_s"]
+    merge_s = merge["full_cycle_s"]
+    table = {
+        "mode": "gspmd", "config": CONFIG,
+        "payload_mb": merge["payload_mb"],
+        "payload_shrink": shrink,
+        "shm_pickle_cycle_s": pickle_s,
+        "shm_oob_cycle_s": oob_s,
+        "shm_delta16_cycle_s": shm["delta16"]["full_cycle_s"],
+        "gspmd_merge_cycle_s": merge_s,
+        "gspmd_speedup_vs_oob": round(oob_s / merge_s, 2),
+        "gspmd_speedup_vs_pickle": round(pickle_s / merge_s, 2),
+        "collective_bytes_mb": merge["collective_bytes_mb"],
+        "collectives": merge["collectives"],
+    }
+    if os.environ.get("VELES_GSPMD_E2E", "1") not in ("0", "off"):
+        # both e2e legs under the SAME forced-8-device env: fused uses
+        # one of the 8 virtual devices, GSPMD shards over all — on a
+        # CPU mesh the ratio measures partitioning overhead (the
+        # devices share cores), on a pod it measures scaling
+        env = {"VELES_DIST_CONFIG": CONFIG, "VELES_DIST_MB": "512",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+        alone = _drain(_spawn("standalone", tpu=False, extra_env=env),
+                       "standalone")
+        gspmd = _drain(_spawn(
+            "standalone", tpu=False,
+            extra_env=dict(env, VELES_GSPMD="auto"), tag="gspmd"),
+            "gspmd")
+        table["standalone_samples_per_sec"] = alone["samples_per_sec"]
+        table["gspmd_samples_per_sec"] = gspmd["samples_per_sec"]
+        table["gspmd_vs_fused_ratio"] = round(
+            gspmd["samples_per_sec"] / alone["samples_per_sec"], 3)
+    print(json.dumps(table))
 
 
 # -- orchestration ---------------------------------------------------------
@@ -973,6 +1108,10 @@ def main():
         orchestrate_chip()
     elif sys.argv[1] == "--cpu-protocol":
         orchestrate_cpu_protocol()
+    elif sys.argv[1] == "--gspmd":
+        orchestrate_gspmd()
+    elif sys.argv[1] == "gspmd-merge":
+        run_gspmd_merge()
     elif sys.argv[1] == "--chaos":
         kind = sys.argv[2] if len(sys.argv) > 2 else "straggler"
         if kind == "straggler":

@@ -288,6 +288,122 @@ def _spmd_recovery_probe():
     return {"spmd_recovery_time_s": recovery}
 
 
+_GSPMD_PROBE = r"""
+import json, os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ.setdefault("VELES_TPU_BACKEND", "cpu")
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.backends import Device
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.models.mnist import MnistWorkflow
+from veles_tpu.parallel import wire
+from veles_tpu.parallel.gspmd import BATCH_AXIS, GSPMDTrainer, gspmd_mesh
+from veles_tpu.parallel.mesh import named_sharding
+from veles_tpu.train import FusedTrainer
+
+SEED = %(seed)d
+
+
+def build_wf():
+    rng = numpy.random.RandomState(SEED)
+    x = rng.rand(160, 6, 6).astype(numpy.float32)
+    y = (x.reshape(160, -1).sum(1) > 18).astype(numpy.int32)
+    prng.get().seed(SEED)
+    prng.get("loader").seed(SEED + 1)
+    wf = MnistWorkflow(
+        DummyLauncher(),
+        provider=lambda: (x[:128], y[:128], x[128:], y[128:]),
+        layers=(16,), minibatch_size=32, learning_rate=0.1,
+        max_epochs=3)
+    wf.initialize(device=Device(backend="cpu"))
+    return wf
+
+
+def curve(history):
+    return [(h["epoch"], h["validation"]["loss"],
+             h["validation"]["normalized"], h["train"]["loss"],
+             h["train"]["normalized"]) for h in history]
+
+
+fused = curve(FusedTrainer(build_wf()).train())
+gspmd = curve(GSPMDTrainer(build_wf()).train())
+parity = 1.0 if fused == gspmd else 0.0
+
+# exchange-cycle ratio: the shm wire's oob encode/copy/decode vs the
+# jitted psum merge, same mid-size tree (sleep-free, so report-only)
+rng = numpy.random.RandomState(SEED)
+tree = {"w0": rng.randn(512, 1024).astype(numpy.float32),
+        "b0": rng.randn(1024).astype(numpy.float32),
+        "w1": rng.randn(1024, 512).astype(numpy.float32)}
+mesh = gspmd_mesh()
+n = mesh.shape[BATCH_AXIS]
+parts = {k: jax.device_put(numpy.broadcast_to(v, (n,) + v.shape),
+                           named_sharding(mesh, BATCH_AXIS))
+         for k, v in tree.items()}
+merge = jax.jit(lambda t: {k: jnp.sum(v, axis=0) for k, v in t.items()},
+                out_shardings=named_sharding(mesh))
+jax.block_until_ready(merge(parts))
+
+
+def best(fn, cycles=5):
+    out = None
+    for _ in range(cycles):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        out = dt if out is None or dt < out else out
+    return out
+
+
+def wire_cycle():
+    blob = wire.encode_chunks(tree).join()
+    decoded = wire.decode(bytes(blob))
+    for arr in decoded.values():
+        arr.ravel()[0]
+
+
+merge_s = best(lambda: jax.block_until_ready(merge(parts)))
+wire_s = best(wire_cycle)
+print(json.dumps({"gspmd_loss_parity": parity,
+                  "gspmd_exchange_speedup": wire_s / merge_s}))
+"""
+
+
+def _gspmd_probe():
+    """ISSUE 15 gate: loss parity of the GSPMD path vs the fused
+    single-device path (HARD — the bit-identity chain to the
+    coordinator tier rests on it), plus the shm-wire-vs-psum exchange
+    cycle ratio (report-only: wall-clock on a shared-core virtual
+    mesh). Runs in a subprocess because the mesh needs the forced
+    8-device CPU platform, which must be set before jax imports."""
+    import subprocess
+    import tempfile
+
+    script = _GSPMD_PROBE % {"repo": HERE, "seed": SEED}
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(script)
+        path = f.name
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    try:
+        out = subprocess.run(
+            [sys.executable, path], env=env, capture_output=True,
+            text=True, timeout=600)
+    finally:
+        os.unlink(path)
+    if out.returncode != 0:
+        raise RuntimeError("gspmd probe failed:\n%s" % out.stderr[-3000:])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 class _ProbePool(object):
     """A replica-pool stand-in with a fixed host-side service delay
     per batch: the serving probes below are SLEEP-dominated (like the
@@ -474,6 +590,7 @@ def capture():
     if rss:
         metrics["host_rss_gb"] = rss / 2.0 ** 30
     metrics.update(_input_pipeline_probe())
+    metrics.update(_gspmd_probe())
     metrics.update(_federation_probe())
     metrics.update(_recovery_probe())
     metrics.update(_spmd_recovery_probe())

@@ -181,6 +181,7 @@ class Main(Logger):
             "auto_resume": getattr(args, "auto_resume", None),
             "straggler_drop_s": getattr(args, "straggler_drop_s", None),
             "reconnect_s": getattr(args, "reconnect_s", None),
+            "gspmd": getattr(args, "gspmd", None),
         }
         if args.listen_address:
             kwargs["listen_address"] = args.listen_address

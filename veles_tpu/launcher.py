@@ -74,7 +74,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         "pipeline", "secret", "secret_file", "max_frame_mb",
         "interactive", "exchange_dtype", "exchange_eps",
         "heartbeat_interval", "auto_resume", "straggler_drop_s",
-        "reconnect_s",
+        "reconnect_s", "gspmd",
     ])
 
     def __init__(self, **kwargs):
@@ -135,6 +135,17 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         #: ``launcher.py:119`` ran the stack under IPython); Shell
         #: units check this to avoid embedding a console in a console
         self.interactive = kwargs.get("interactive", False)
+        #: GSPMD tier (ISSUE 15): a mesh spec string ("auto",
+        #: "batch=8,model=1", "8x1") routes the standalone run through
+        #: one jitted SPMD step over the named batch×model mesh — the
+        #: gradient merge is a compiler-inserted psum instead of the
+        #: coordinator's host-mediated exchange. None/"" = off.
+        #: VELES_GSPMD env is the fallback (the bench legs use it).
+        import os as _os
+        gspmd = kwargs.get("gspmd")
+        if gspmd in (None, ""):
+            gspmd = _os.environ.get("VELES_GSPMD") or None
+        self.gspmd = gspmd
         #: minibatches per distributed job (1 = reference-style);
         #: segments amortize the round-trip + weight exchange
         self.segment_size = kwargs.get("segment_size", 8)
@@ -222,6 +233,16 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
             help="run the eager per-unit scheduler instead of the fused "
                  "XLA step compiler (the default for standard-shaped "
                  "workflows)")
+        parser.add_argument(
+            "--gspmd", dest="gspmd", nargs="?", const="auto",
+            default=None, metavar="MESH",
+            help="standalone/pod: run the single-launcher GSPMD path — "
+                 "the whole train step under one jit with NamedShardings "
+                 "over a named batch×model mesh, gradient merge as a "
+                 "compiler-inserted psum over ICI (docs/"
+                 "distributed_training.md §GSPMD tier). MESH like "
+                 "'batch=8,model=1' or '8x1'; bare --gspmd puts every "
+                 "device on the batch axis (VELES_GSPMD env fallback)")
         parser.add_argument(
             "--segment-size", type=int, default=8,
             help="minibatches per distributed job (master mode); 1 "
@@ -858,11 +879,20 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         or when the graph does not fit the step compiler's contract."""
         workflow = self.workflow
         if self.eager:
+            if self.gspmd:
+                raise RuntimeError(
+                    "--gspmd and --eager are mutually exclusive: the "
+                    "GSPMD tier runs the whole step under one jit")
             self.info("running the eager per-unit scheduler (--eager)")
             self.run_mode_used = "eager"
             return workflow.run()
         custom = workflow.make_fused_runner()
         if custom is not None:
+            if self.gspmd:
+                raise RuntimeError(
+                    "--gspmd requested but the workflow supplies its "
+                    "own fused runner (%s), which the GSPMD trainer "
+                    "cannot drive" % type(custom).__name__)
             self.info("running the workflow's own fused runner (%s)",
                       type(custom).__name__)
             self.run_mode_used = "fused"
@@ -870,9 +900,24 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         from veles_tpu.train.runner import FusedRunner, fused_compatible
         reason = fused_compatible(workflow)
         if reason is not None:
+            if self.gspmd:
+                # the GSPMD tier IS the step compiler; a graph it
+                # cannot model cannot run launcher-SPMD either
+                raise RuntimeError(
+                    "--gspmd requested but the fused path is "
+                    "unavailable: %s" % reason)
             self.info("fused path unavailable (%s); running eager", reason)
             self.run_mode_used = "eager"
             return workflow.run()
+        if self.gspmd:
+            from veles_tpu.parallel.gspmd import (GSPMDTrainer,
+                                                  parse_mesh_spec)
+            mesh = parse_mesh_spec(self.gspmd)
+            self.info("running the GSPMD path over mesh %s",
+                      dict(mesh.shape))
+            self.run_mode_used = "gspmd"
+            trainer = GSPMDTrainer(workflow, mesh=mesh)
+            return FusedRunner(workflow, trainer=trainer).run()
         self.info("running the fused XLA step compiler")
         self.run_mode_used = "fused"
         return FusedRunner(workflow).run()

@@ -19,6 +19,15 @@ plane becomes XLA collectives over ICI/DCN under a single controller:
   handshake with topology checksum, heartbeats, elastic requeue and
   chaos injection for task farming (genetics/ensemble) and multi-host
   bring-up. Data never flows through it;
+* :mod:`gspmd`       — the pod-scale launcher-SPMD tier (ISSUE 15):
+  one ``jit`` over a named ``batch``×``model`` mesh unifying dp's
+  batch placement and tp's model rules into the sharding specs of a
+  single compiled step, loss curve bit-identical to the coordinator
+  path by construction;
+* :mod:`reshard`     — the measured array-redistribution primitive
+  (Zhuang et al. recipe): checkpoint restore at a different mesh
+  shape and train→serve layout moves, all under
+  ``veles_reshard_ms{src,dst}``;
 * :mod:`elastic`     — the SPMD recovery plane (ISSUE 13):
   generation-numbered rendezvous, per-host worker supervisors, and
   sharded checkpoint-restart so a ``jax.distributed`` pod that loses
@@ -32,6 +41,8 @@ plane becomes XLA collectives over ICI/DCN under a single controller:
 from veles_tpu.parallel.mesh import (build_mesh, local_device_count,  # noqa
                                      named_sharding)
 from veles_tpu.parallel.dp import DataParallelTrainer  # noqa: F401
+from veles_tpu.parallel.gspmd import (GSPMDTrainer,  # noqa: F401
+                                      gspmd_mesh)
 from veles_tpu.parallel.ep import moe_ffn  # noqa: F401
 from veles_tpu.parallel.sequence import (ring_attention,  # noqa: F401
                                          ulysses_attention)

@@ -93,26 +93,18 @@ class DataParallelTrainer(FusedTrainer):
 
     def _shard_placer(self):
         """Streamed shards land directly as addressable per-device
-        shards of the ``data``-axis ``NamedSharding`` — each device
+        shards of the data-axis ``NamedSharding`` — each device
         receives its row slice of the host shard straight from host
         memory (``put_global``: plain sharded ``device_put``
         single-process, ``make_array_from_callback`` multi-controller).
         No device ever sees the full shard, and there is no
-        gather-then-scatter hop."""
-        n_shards = self.mesh.shape[self.axis]
-        spec = self._data_spec
-
-        def place(host_array):
-            import numpy
-            pad = -host_array.shape[0] % n_shards
-            if pad:
-                # local shard indices never reach the pad rows
-                host_array = numpy.concatenate([
-                    host_array,
-                    numpy.zeros((pad,) + host_array.shape[1:],
-                                host_array.dtype)])
-            return put_global(host_array, spec)
-        return place
+        gather-then-scatter hop. The pad-and-place implementation is
+        :func:`veles_tpu.loader.prefetch.sharded_placer` (local shard
+        indices never reach the pad rows), routed through the measured
+        reshard primitive (ISSUE 15)."""
+        from veles_tpu.loader import prefetch
+        return prefetch.sharded_placer(self._data_spec,
+                                       self.mesh.shape[self.axis])
 
     def _params_spec(self):
         if self._param_shardings is not None:
@@ -168,18 +160,21 @@ class DataParallelTrainer(FusedTrainer):
     def pull_params(self):
         """Re-place host-committed params onto the mesh per the declared
         shardings (a committed single-device array would otherwise clash
-        with the jit's in_shardings)."""
+        with the jit's in_shardings) — through the measured reshard
+        primitive (ISSUE 15), so an elastic restore at a NEW mesh shape
+        shows its re-placement cost as ``veles_reshard_ms``."""
+        from veles_tpu.parallel import reshard
         params, states = super(DataParallelTrainer, self).pull_params()
         spec = self._params_spec()
         if not isinstance(spec, (tuple, list)):
             spec = tuple(spec for _ in params)
         params = tuple(
-            {k: put_global(v, spec[i][k]
-                           if isinstance(spec[i], dict)
-                           else spec[i])
+            {k: reshard.reshard(v, spec[i][k]
+                                if isinstance(spec[i], dict)
+                                else spec[i])
              for k, v in layer.items()}
             for i, layer in enumerate(params))
         repl = named_sharding(self.mesh)
         states = jax.tree_util.tree_map(
-            lambda v: put_global(v, repl), states)
+            lambda v: reshard.reshard(v, repl), states)
         return params, states

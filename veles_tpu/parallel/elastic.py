@@ -844,7 +844,13 @@ def save_elastic_checkpoint(trainer, ctx, params, states):
         process_count=jax.process_count(),
         tag="_g%d" % ctx.generation, barrier=barrier, link_tag="",
         manifest_extra={"world_size": ctx.world_size,
-                        "generation": ctx.generation})
+                        "generation": ctx.generation,
+                        # the SOURCE mesh shape, so a restore at a new
+                        # world size can log/verify the A->B reshard
+                        "mesh_axes": {str(k): int(v) for k, v in
+                                      dict(trainer.mesh.shape).items()}
+                        if getattr(trainer, "mesh", None) is not None
+                        else None})
 
 
 def run_elastic_training(build_workflow, device=None, mesh=None,
@@ -897,11 +903,15 @@ def run_elastic_training(build_workflow, device=None, mesh=None,
                  "at world size %d", restored_path, resume_epoch,
                  ctx.world_size)
     if mesh is None:
-        from veles_tpu.parallel.mesh import build_mesh
-        mesh = build_mesh()
+        # the launcher-SPMD tier's named batch×model mesh (ISSUE 15):
+        # an elastic world-size change = this mesh re-built over the
+        # surviving devices + reshard-on-restore through pull_params'
+        # measured re-placement (parallel/reshard.py)
+        from veles_tpu.parallel.gspmd import gspmd_mesh
+        mesh = gspmd_mesh()
     if trainer_cls is None:
-        from veles_tpu.parallel.dp import DataParallelTrainer
-        trainer_cls = DataParallelTrainer
+        from veles_tpu.parallel.gspmd import GSPMDTrainer
+        trainer_cls = GSPMDTrainer
     trainer = trainer_cls(workflow, mesh=mesh,
                           **(trainer_kwargs or {}))
     if snapdir:

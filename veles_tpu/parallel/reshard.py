@@ -1,0 +1,138 @@
+"""Array redistribution between device layouts (ISSUE 15).
+
+The Zhuang et al. recipe (PAPERS.md, "Memory-efficient array
+redistribution through portable collective communication"): any
+layout change decomposes into all-gather / dynamic-slice /
+collective-permute primitives, and the right decomposition is the
+compiler's job — under a single controller, ``jax.device_put`` onto
+the target ``NamedSharding`` lowers to exactly that minimal program
+(multi-controller placements go through
+:func:`veles_tpu.parallel.mesh.put_global`'s per-process shard
+contribution instead). What this module adds is the *seam*: one
+measured primitive every layout move in the repo goes through, so
+
+* sharded-checkpoint restore at a DIFFERENT mesh shape (a world-size-N
+  generation re-placed onto a world-size-M mesh — the elastic
+  supervisor's reshard-on-restore),
+* train→serve moves (model-axis-sharded training params gathered to
+  the replicated layout serving replicas consume),
+* the per-run host→mesh parameter placement (``pull_params``),
+
+all show up in ``veles_reshard_ms{src,dst}`` instead of hiding inside
+whatever code path happened to call ``device_put``.
+
+Labels are LAYOUTS, not meshes: ``P(batch)``/``P(_,model)``/
+``replicated``/``host``/``committed`` — bounded cardinality however
+many mesh shapes a run moves between.
+"""
+
+import time
+
+import jax
+import numpy
+
+from veles_tpu.parallel.mesh import put_global
+from veles_tpu.telemetry import tracing
+
+
+def _registry():
+    from veles_tpu.telemetry.registry import get_registry
+    return get_registry()
+
+
+def reshard_histogram():
+    return _registry().histogram(
+        "veles_reshard_ms",
+        "Array redistribution time between device layouts",
+        labels=("src", "dst"))
+
+
+def layout_label(value_or_sharding):
+    """Bounded-cardinality layout label for a sharding, array or host
+    value: ``replicated``, ``P(batch)``, ``P(_,model)``, ``host`` (not
+    on any device yet), or ``committed`` (a device placement without a
+    named spec — single-device arrays)."""
+    value = value_or_sharding
+    if isinstance(value, jax.Array):
+        value = value.sharding
+    elif not isinstance(value, jax.sharding.Sharding):
+        return "host"
+    spec = getattr(value, "spec", None)
+    if spec is None:
+        return "committed"
+    parts = []
+    for entry in spec:
+        if entry is None:
+            parts.append("_")
+        elif isinstance(entry, (tuple, list)):
+            parts.append("+".join(str(e) for e in entry))
+        else:
+            parts.append(str(entry))
+    # trailing unsharded dims are elided by PartitionSpec; P() means
+    # fully replicated whatever the rank
+    while parts and parts[-1] == "_":
+        parts.pop()
+    return "P(%s)" % ",".join(parts) if parts else "replicated"
+
+
+def reshard(value, sharding, *, block=False):
+    """Move ``value`` (host ndarray or ``jax.Array`` in any layout) to
+    ``sharding``, measured as ``veles_reshard_ms{src,dst}``.
+
+    ``block=True`` waits for the moved buffers (honest end-to-end
+    reshard time — checkpoint restore, train→serve moves);
+    ``block=False`` records the dispatch time only, preserving async
+    transfer for hot paths (streamed shard placement, per-run
+    parameter pull) exactly like ``veles_prefetch_h2d_ms`` does.
+    """
+    if isinstance(value, jax.Array) and \
+            value.sharding.is_equivalent_to(sharding, value.ndim):
+        return value  # already in the target layout: no move to measure
+    src = layout_label(value)
+    dst = layout_label(sharding)
+    t0 = time.perf_counter()
+    if isinstance(value, jax.Array) and jax.process_count() > 1:
+        if value.is_fully_addressable:
+            # a process-local array (host-committed params): read it
+            # out and contribute per-process shards like a host value
+            out = put_global(numpy.asarray(value), sharding)
+        else:
+            # a live GLOBAL array reshards through device_put (the
+            # all-gather/dynamic-slice decomposition across processes;
+            # jaxlibs that cannot do this raise here — the callers
+            # that reach it (model-sharded push_params under
+            # multi-controller) degrade by keeping the source layout)
+            out = jax.device_put(value, sharding)
+    else:
+        out = put_global(value, sharding)
+    if block:
+        jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    reshard_histogram().labels(src=src, dst=dst).observe(elapsed * 1e3)
+    tracing.add_complete("reshard", t0, elapsed, src=src, dst=dst)
+    return out
+
+
+def reshard_tree(tree, shardings, *, block=False):
+    """``reshard`` every leaf of ``tree``; ``shardings`` is either one
+    sharding for all leaves or a matching pytree prefix of shardings."""
+    if isinstance(shardings, jax.sharding.Sharding):
+        return jax.tree_util.tree_map(
+            lambda v: reshard(v, shardings, block=block), tree)
+    return jax.tree_util.tree_map(
+        lambda v, s: reshard(v, s, block=block), tree, shardings)
+
+
+def gather_to_host(value):
+    """The serve-side terminal move: any layout -> a full host ndarray
+    (the all-gather decomposition, then device->host). Measured under
+    ``dst="host"``. Serving replicas (and single-file snapshots)
+    consume exactly this form."""
+    src = layout_label(value)
+    t0 = time.perf_counter()
+    out = numpy.asarray(value)
+    elapsed = time.perf_counter() - t0
+    reshard_histogram().labels(src=src, dst="host").observe(
+        elapsed * 1e3)
+    tracing.add_complete("reshard", t0, elapsed, src=src, dst="host")
+    return out

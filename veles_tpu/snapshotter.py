@@ -669,6 +669,21 @@ def save_snapshot_sharded(workflow, directory, records, *,
     return gen_dir, nbytes
 
 
+def generation_manifest(gen_dir):
+    """The manifest dict of a sharded generation directory (ISSUE 15:
+    carries ``mesh_axes``/``world_size`` of the SOURCE layout, so a
+    restore at a different mesh shape can name the A->B reshard it is
+    about to perform). Raises like :func:`load_sharded_generation` on
+    a torn generation."""
+    import json
+    with open(os.path.join(gen_dir, MANIFEST_NAME)) as fin:
+        manifest = json.load(fin)
+    if manifest.get("kind") != "veles-sharded-snapshot":
+        raise pickle.UnpicklingError(
+            "not a sharded snapshot manifest: %s" % gen_dir)
+    return manifest
+
+
 def _read_part_file(path):
     with _open_for_read(path) as fin:
         part = pickle.load(fin)
@@ -725,11 +740,7 @@ def load_sharded_generation(gen_dir):
     generation, exactly like a corrupt single-file snapshot."""
     import json
     import numpy as _np
-    with open(os.path.join(gen_dir, MANIFEST_NAME)) as fin:
-        manifest = json.load(fin)
-    if manifest.get("kind") != "veles-sharded-snapshot":
-        raise pickle.UnpicklingError(
-            "not a sharded snapshot manifest: %s" % gen_dir)
+    manifest = generation_manifest(gen_dir)
     parts = [_read_part_file(os.path.join(gen_dir, name))
              for name in manifest["parts"]]
     part0 = next((p for p in parts if "workflow" in p), None)

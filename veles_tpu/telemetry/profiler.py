@@ -127,6 +127,67 @@ def harvest_cost_analysis(compiled):
             "bytes": _first(costs, "bytes accessed")}
 
 
+#: bytes per element for the HLO shape tokens collective outputs use
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+_COLLECTIVE_RE = None
+
+
+def collective_bytes_estimate(compiled):
+    """Per-execution bytes moved by the COMPILER-INSERTED collectives
+    of a partitioned program (ISSUE 15): ``{"bytes": b, "count": n}``,
+    or None when the program text is unavailable.
+
+    ``cost_analysis()`` reports only whole-program aggregates (no
+    per-instruction-category split on any backend this repo meets), so
+    the collective share is read from the optimized HLO itself: the
+    summed output-shape bytes of every ``all-reduce`` / ``all-gather``
+    / ``all-to-all`` / ``collective-permute`` / ``reduce-scatter``
+    instruction, per participating device. Async pairs are counted
+    once via their ``-done`` half — a ``-start``'s result tuple
+    aliases the operand buffers too, which would double the bytes —
+    while synchronous lowerings (CPU) match on the bare name. An estimate — the gradient psum's wire
+    traffic depends on the ICI algorithm — but it moves exactly when
+    the partitioning moves, which is what the gauge is for."""
+    global _COLLECTIVE_RE
+    import re
+    if _COLLECTIVE_RE is None:
+        _COLLECTIVE_RE = (
+            re.compile(r"=\s*([^=]*?)\s"
+                       r"(?:all-reduce|all-gather|all-to-all|"
+                       r"collective-permute|reduce-scatter|"
+                       r"collective-broadcast)(?:-done)?\("),
+            re.compile(r"([a-z]\w*)\[([0-9,]*)\]"))
+    line_re, shape_re = _COLLECTIVE_RE
+    try:
+        texts = compiled.as_text()
+    except Exception:
+        return None
+    if not texts:
+        return None
+    if isinstance(texts, str):
+        texts = [texts]
+    total = 0
+    count = 0
+    for text in texts:
+        for match in line_re.finditer(text):
+            count += 1
+            for dtype, dims in shape_re.findall(match.group(1)):
+                size = _HLO_DTYPE_BYTES.get(dtype)
+                if size is None:
+                    continue
+                n = 1
+                for d in dims.split(","):
+                    if d:
+                        n *= int(d)
+                total += n * size
+    return {"bytes": total, "count": count}
+
+
 class CostBook(object):
     """Per-op ledger: analytic cost (harvested once per op) joined with
     measured wall time (observed per call) and the device roofline.
@@ -155,6 +216,12 @@ class CostBook(object):
         self._g_mfu = registry.gauge(
             "veles_step_mfu", "Model FLOPs utilization of the train "
             "step (analytic FLOPs / measured time / device peak)")
+        self._g_coll = registry.gauge(
+            "veles_op_collective_bytes",
+            "Estimated bytes moved per execution by the "
+            "compiler-inserted collectives of a partitioned op "
+            "(summed HLO collective output shapes, per device)",
+            labels=("op",))
 
     # -- recording ---------------------------------------------------------
 
@@ -189,10 +256,19 @@ class CostBook(object):
             cost = None
         if cost is None:
             return
+        # the partitioned (GSPMD) ops also surface their collective
+        # share — zero collectives is a meaningful reading too (a
+        # "sharded" step that inserted none is not actually sharded)
+        coll = collective_bytes_estimate(compiled)
+        if coll is not None:
+            cost["collective_bytes"] = coll["bytes"]
+            cost["collective_count"] = coll["count"]
         with self._lock:
             self._costs[op] = cost
         self._g_flops.labels(op=op).set(cost["flops"])
         self._g_bytes.labels(op=op).set(cost["bytes"])
+        if coll is not None:
+            self._g_coll.labels(op=op).set(coll["bytes"])
 
     def observe_ms(self, op, elapsed_s):
         self._h_ms.labels(op=op).observe(elapsed_s * 1e3)
@@ -237,6 +313,9 @@ class CostBook(object):
                    "calls": times.get("count", 0),
                    "p50_ms": times.get("p50"),
                    "p95_ms": times.get("p95")}
+            if "collective_bytes" in cost:
+                row["collective_bytes"] = cost["collective_bytes"]
+                row["collective_count"] = cost.get("collective_count")
             flops, byts = cost.get("flops"), cost.get("bytes")
             if flops and byts:
                 row["arithmetic_intensity"] = flops / byts

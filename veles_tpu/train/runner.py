@@ -165,10 +165,16 @@ class FusedRunner(Logger):
             if phase == "train" and not self._first_step_done:
                 self._first_step_done = True
                 profiler.record_phase("first_step", elapsed)
-        op = "train_segment" if phase == "train" else "eval_segment"
+        # parallel trainers compile a different program for the same
+        # sweep — their _op_prefix keeps the cost rows separate (the
+        # GSPMD path's rows are gspmd_train_segment etc., ISSUE 15)
+        prefix = getattr(self.trainer, "_op_prefix", "")
+        op = prefix + ("train_segment" if phase == "train"
+                       else "eval_segment")
         self._book.observe_ms(op, elapsed)
         if phase == "train":
-            self._book.record_step_mfu("train_segment", elapsed)
+            self._book.record_step_mfu(prefix + "train_segment",
+                                       elapsed)
         self._flight.observe_step(phase, elapsed,
                                   loss=self._last_batch[0],
                                   epoch=self._epoch_index)

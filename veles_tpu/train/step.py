@@ -61,6 +61,13 @@ class FusedTrainer(Logger):
     ``VELES_DEVICE_BUDGET_MB`` override); True/False force.
     """
 
+    #: cost-book op namespace: parallel trainers that compile a
+    #: DIFFERENT program for the same sweep (the GSPMD path's
+    #: partitioned step, ISSUE 15) prefix their op names so their
+    #: cost/collective-bytes rows never mix with the single-device
+    #: program's — the runner reads this too
+    _op_prefix = ""
+
     def __init__(self, workflow, donate=None, stage_s2d=True,
                  grad_norms=None, stream=None, prefetch_depth=None,
                  prefetch_workers=None):
@@ -103,6 +110,10 @@ class FusedTrainer(Logger):
         for gd in getattr(workflow, "gds", []):
             self.gd_for[id(gd.forward)] = gd
         self._build()
+
+    def _op(self, name):
+        """Cost-book op name under this trainer's namespace."""
+        return self._op_prefix + name
 
     @staticmethod
     def _resolve_donate(donate):
@@ -412,7 +423,7 @@ class FusedTrainer(Logger):
         def run_shard(data_args, local_idx, row0, row1):
             args = (data_args, state[0], state[1], local_idx,
                     keys[row0:row1])
-            harvest = self._prepare_harvest("train_segment", jit_train,
+            harvest = self._prepare_harvest(self._op("train_segment"), jit_train,
                                             args)
             out = jit_train(*args)
             if harvest is not None:
@@ -432,7 +443,7 @@ class FusedTrainer(Logger):
     def _eval_segment_streamed(self, jit_eval, params_list, idx_matrix):
         def run_shard(data_args, local_idx, row0, row1):
             args = (data_args, params_list, local_idx)
-            harvest = self._prepare_harvest("eval_segment", jit_eval,
+            harvest = self._prepare_harvest(self._op("eval_segment"), jit_eval,
                                             args)
             out = jit_eval(*args)
             if harvest is not None:
@@ -578,7 +589,7 @@ class FusedTrainer(Logger):
             # overlaps the segment's async execution. Measured times
             # are observed by the callers that BLOCK on the results
             # (dispatch here is async — timing it would be a lie).
-            harvest = self._prepare_harvest("train_segment", jit_train,
+            harvest = self._prepare_harvest(self._op("train_segment"), jit_train,
                                             args)
             out = jit_train(*args)
             if harvest is not None:
@@ -617,7 +628,7 @@ class FusedTrainer(Logger):
                 return self._eval_segment_streamed(
                     jit_eval, params_list, idx_matrix)
             args = (self._data_args, params_list, idx_matrix)
-            harvest = self._prepare_harvest("eval_segment", jit_eval,
+            harvest = self._prepare_harvest(self._op("eval_segment"), jit_eval,
                                             args)
             out = jit_eval(*args)
             if harvest is not None:
