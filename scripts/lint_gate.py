@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""CI lint gate over the veles-analyze static checkers.
+
+Mirrors ``scripts/perf_gate.py``'s design: a committed baseline
+(``scripts/lint_baseline.json``) records the accepted debt with a
+human-written reason per entry; anything the checkers find that is NOT
+in the baseline hard-fails the job. Stale suppressions (fingerprints
+no checker produces any more) are reported so the baseline only ever
+shrinks.
+
+Modes
+-----
+(default)        analyze veles_tpu/ against the baseline; exit 1 on
+                 any unsuppressed finding.
+--self-test      prove the gate CAN fail: run the checkers over the
+                 known-bad fixtures in tests/fixtures/lint/ and
+                 REQUIRE every checker code to fire (and the known-
+                 clean fixture to stay clean). A gate that cannot
+                 fail gates nothing — CI runs this next to the real
+                 gate, like perf_gate's regressed-fixture step.
+--update-baseline  rewrite the baseline from current findings
+                 (requires --reason); for paying down or accepting
+                 debt deliberately, never run in CI.
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from veles_tpu.analysis import core                    # noqa: E402
+from veles_tpu.analysis.__main__ import build_project  # noqa: E402
+
+BASELINE = os.path.join(REPO, "scripts", "lint_baseline.json")
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+#: every code the self-test requires the bad fixtures to produce —
+#: one per checker rule, so a silently-dead rule fails CI
+EXPECTED_CODES = (
+    "LOCK001", "LOCK002", "LOCK003",
+    "TRACE001", "TRACE002", "TRACE003", "TRACE004", "TRACE005",
+    "TRACE006",
+    "MET001", "MET002", "MET003",
+    "KNOB001", "KNOB002", "KNOB003",
+)
+
+
+def run_gate(baseline_path):
+    project = build_project([os.path.join(REPO, "veles_tpu")], REPO)
+    findings = core.run_all(project)
+    baseline = core.load_baseline(baseline_path)
+    new, suppressed, stale = core.apply_baseline(findings, baseline)
+    for f in new:
+        print("FAIL %s" % f.render())
+    if suppressed:
+        print("     %d baseline-suppressed finding(s)" % len(suppressed))
+    for fp in stale:
+        print("WARN stale suppression %s — debt paid, remove it from "
+              "scripts/lint_baseline.json" % fp)
+    print("lint gate: %d file(s), %d new finding(s) -> %s"
+          % (len(project.modules), len(new),
+             "FAIL" if new else "PASS"))
+    return 1 if new else 0
+
+
+def run_self_test():
+    bad = [os.path.join(FIXTURES, name)
+           for name in sorted(os.listdir(FIXTURES))
+           if name.startswith("bad_") and name.endswith(".py")]
+    clean = [os.path.join(FIXTURES, "clean.py")]
+    if not bad:
+        print("SELF-TEST FAIL: no bad fixtures under %s" % FIXTURES)
+        return 1
+    project = build_project(bad, REPO, complete=False)
+    findings = core.run_all(project)
+    fired = {f.code for f in findings}
+    missing = [c for c in EXPECTED_CODES if c not in fired]
+    ok = True
+    if missing:
+        ok = False
+        print("SELF-TEST FAIL: known-bad fixtures did not trigger %s "
+              "— those rules are dead and gate nothing"
+              % ", ".join(missing))
+    if not findings:
+        ok = False
+        print("SELF-TEST FAIL: the gate cannot fail")
+    clean_findings = core.run_all(build_project(clean, REPO,
+                                                complete=False))
+    if clean_findings:
+        ok = False
+        for f in clean_findings:
+            print("SELF-TEST FAIL (clean fixture): %s" % f.render())
+    print("lint gate self-test: %d finding(s) on bad fixtures, "
+          "%d code(s) covered, clean fixture %s -> %s"
+          % (len(findings), len(fired & set(EXPECTED_CODES)),
+             "clean" if not clean_findings else "DIRTY",
+             "PASS" if ok else "FAIL"))
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--baseline", default=BASELINE)
+    parser.add_argument("--self-test", action="store_true")
+    parser.add_argument("--update-baseline", action="store_true")
+    parser.add_argument("--reason", default="")
+    args = parser.parse_args(argv)
+    if args.self_test:
+        return run_self_test()
+    if args.update_baseline:
+        if not args.reason.strip():
+            parser.error("--update-baseline requires --reason")
+        project = build_project([os.path.join(REPO, "veles_tpu")], REPO)
+        findings = core.run_all(project)
+        core.write_baseline(args.baseline, findings, args.reason)
+        print("baseline rewritten: %d suppression(s)" % len(findings))
+        return 0
+    return run_gate(args.baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

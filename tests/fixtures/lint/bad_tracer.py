@@ -1,0 +1,41 @@
+"""Known-bad fixture: every tracer-hygiene code. Never imported (jax
+need not be installed to PARSE this)."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+HISTORY = []
+
+
+@jax.jit
+def impure_step(x):
+    print("step", x)                      # TRACE001
+    t0 = time.perf_counter()              # TRACE002
+    noise = np.random.uniform(size=3)     # TRACE003
+    scale = x.mean().item()               # TRACE004
+    HISTORY.append(scale)                 # TRACE005
+    mode = os.environ.get("VELES_MODE")   # TRACE006
+    return x * scale + noise.sum() + t0, mode
+
+
+def _helper(x):
+    # tainted: called from the jitted body below
+    time.sleep(0.1)                       # TRACE002 via taint
+    return x
+
+
+def outer(x):
+    def body(carry, item):
+        return _helper(carry) + item, item
+    return jax.lax.scan(body, x, jnp.arange(3))
+
+
+@jax.jit
+def clean_step(x):
+    # the sanctioned escape hatch is exempt
+    jax.debug.print("x = {}", x)
+    return x * 2

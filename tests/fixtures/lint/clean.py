@@ -1,0 +1,41 @@
+"""Known-clean fixture: every checker must report ZERO findings here.
+Never imported."""
+
+import threading
+
+import jax
+
+from veles_tpu.envknob import env_knob
+
+
+class DisciplinedCounter(object):
+    """Every post-init write to guarded state holds the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.items = []
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+            self.items.append(self.count)
+
+    def reset(self):
+        with self._lock:
+            self.count = 0
+            self.items.clear()
+
+    def _restock_locked(self, items):
+        # the *_locked naming convention marks caller-holds-lock
+        self.items.extend(items)
+
+
+@jax.jit
+def pure_step(x, scale):
+    return x * scale + 1.0
+
+
+def documented_knob():
+    # VELES_PREFETCH is catalogued in docs/CONFIGURATION.md
+    return env_knob("VELES_PREFETCH", 2, parse=int)

@@ -1,0 +1,171 @@
+"""veles-analyze checker pins: each rule against its known-bad fixture,
+the known-clean fixture against every rule, baseline round-trip and
+fingerprint stability, and the whole-tree invariant the CI lint gate
+enforces (zero unsuppressed findings at head)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from veles_tpu.analysis import core
+from veles_tpu.analysis.__main__ import build_project
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def analyze(*names, checkers=None):
+    paths = [os.path.join(FIXTURES, n) for n in names]
+    project = build_project(paths, REPO, complete=False)
+    return core.run_all(project, checkers)
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# -- per-checker pins --------------------------------------------------------
+
+
+def test_lock_fixture_fires():
+    findings = analyze("bad_lock.py", checkers=["locks"])
+    by_code = codes(findings)
+    # reset(): two unlocked writes (count assignment, items.clear())
+    assert by_code.count("LOCK001") == 2
+    # outer(): direct re-entry; indirect(): via helper()
+    assert by_code.count("LOCK003") == 2
+    keys = {f.key for f in findings}
+    assert "TornCounter.reset.count" in keys
+    assert "TornCounter.reset.items" in keys
+
+
+def test_lock_order_cycle_fires_once():
+    findings = analyze("bad_lock_order.py", checkers=["locks"])
+    assert codes(findings) == ["LOCK002"]
+    assert "_a" in findings[0].message and "_b" in findings[0].message
+
+
+def test_tracer_fixture_fires_every_code():
+    findings = analyze("bad_tracer.py", checkers=["tracer"])
+    fired = set(codes(findings))
+    assert {"TRACE001", "TRACE002", "TRACE003", "TRACE004",
+            "TRACE005", "TRACE006"} <= fired
+    # taint: _helper is only impure via the scan body that calls it
+    assert any(f.key.startswith("_helper.") for f in findings)
+    # the sanctioned escape hatch must NOT fire
+    assert not any(f.key.startswith("clean_step.") for f in findings)
+
+
+def test_metric_fixture():
+    findings = analyze("bad_metric.py", "bad_alerts.py",
+                       checkers=["metrics"])
+    fired = codes(findings)
+    assert "MET001" in fired          # ghost family not in the catalog
+    assert fired.count("MET002") == 2  # f-string and %-format labels
+    met3 = [f for f in findings if f.code == "MET003"]
+    flagged = {f.key.split(".")[-1] for f in met3}
+    assert "veles_fixture_never_minted_total" in flagged
+    assert "veles_fixture_also_never_minted_total" in flagged
+    # veles_step_ms is minted inside the fixture set -> not flagged
+    assert "veles_step_ms" not in flagged
+
+
+def test_knob_fixture():
+    findings = analyze("bad_knob.py", checkers=["knobs"])
+    fired = codes(findings)
+    assert "KNOB001" in fired
+    assert fired.count("KNOB002") == 2   # .get() and subscript reads
+    assert "KNOB003" in fired
+    argparse_finding = next(f for f in findings if f.code == "KNOB003")
+    assert "VELES_FIXTURE_ARGPARSE_KNOB" in argparse_finding.message
+
+
+def test_clean_fixture_is_clean():
+    assert analyze("clean.py") == []
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    project = core.Project.load([str(bad)], str(tmp_path))
+    findings = core.run_all(project)
+    assert [f.code for f in findings] == ["CORE001"]
+
+
+# -- fingerprints & baseline -------------------------------------------------
+
+
+def test_fingerprint_survives_line_shifts(tmp_path):
+    src = open(os.path.join(FIXTURES, "bad_lock.py")).read()
+    a = tmp_path / "mod.py"
+    a.write_text(src)
+    before = core.run_all(core.Project.load([str(a)], str(tmp_path)))
+    a.write_text("# one\n# two\n# three\n" + src)
+    after = core.run_all(core.Project.load([str(a)], str(tmp_path)))
+    assert [f.fingerprint for f in before] == \
+        [f.fingerprint for f in after]
+    assert [f.line + 3 for f in before] == [f.line for f in after]
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = analyze("bad_lock.py", checkers=["locks"])
+    path = str(tmp_path / "baseline.json")
+    core.write_baseline(path, findings, "legacy debt, tracked")
+    baseline = core.load_baseline(path)
+    new, suppressed, stale = core.apply_baseline(findings, baseline)
+    assert new == [] and len(suppressed) == len(findings)
+    assert stale == []
+    # a fixed finding leaves its suppression stale
+    new, suppressed, stale = core.apply_baseline(findings[1:], baseline)
+    assert stale == [findings[0].fingerprint]
+
+
+def test_baseline_requires_reason(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({
+        "schema": core.BASELINE_SCHEMA,
+        "suppressions": [{"fingerprint": "abc123", "reason": "  "}]}))
+    with pytest.raises(ValueError, match="reason"):
+        core.load_baseline(str(path))
+
+
+def test_missing_baseline_suppresses_nothing(tmp_path):
+    assert core.load_baseline(str(tmp_path / "absent.json")) == {}
+
+
+# -- the whole-tree invariant ------------------------------------------------
+
+
+def test_repo_tree_has_no_unsuppressed_findings():
+    """The acceptance criterion the CI lint gate enforces, pinned as a
+    test: ``python -m veles_tpu.analysis`` is clean at head."""
+    project = build_project([os.path.join(REPO, "veles_tpu")], REPO)
+    findings = core.run_all(project)
+    baseline = core.load_baseline(
+        os.path.join(REPO, "scripts", "lint_baseline.json"))
+    new, _, stale = core.apply_baseline(findings, baseline)
+    assert new == [], "\n".join(f.render() for f in new)
+    assert stale == [], "stale suppressions: %s" % (stale,)
+
+
+def test_lint_gate_cli_and_self_test():
+    gate = os.path.join(REPO, "scripts", "lint_gate.py")
+    for extra in ([], ["--self-test"]):
+        proc = subprocess.run(
+            [sys.executable, gate] + extra, capture_output=True,
+            text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_fails_on_bad_fixture():
+    """The gate proves it can fail: the known-bad fixtures must exit
+    non-zero through the real CLI."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "veles_tpu.analysis", "--no-baseline",
+         os.path.join(FIXTURES, "bad_lock.py")],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "LOCK001" in proc.stdout

@@ -1,0 +1,37 @@
+"""veles-analyze: the repo-native static analysis plane.
+
+Four AST checkers encode contracts the test suite cannot see —
+they hold *between* runs, across threads, or between code and docs:
+
+* :mod:`veles_tpu.analysis.locks` — lock discipline. Attributes
+  consistently written under ``with self._lock:`` must not be written
+  outside it, lock acquisition order must be acyclic, and a
+  non-reentrant ``threading.Lock`` must not be re-acquired on a path
+  that already holds it.
+* :mod:`veles_tpu.analysis.tracer` — JAX tracer hygiene. Host-impure
+  calls (``time.*``, ``numpy.random``, ``print``, ``.item()``,
+  captured-container mutation, ``os.environ``) must not be reachable
+  from inside a ``jit`` / ``pallas_call`` / ``custom_vjp``-traced
+  function: they run at trace time, silently bake one value into the
+  compiled program, and diverge on cache hits.
+* :mod:`veles_tpu.analysis.metrics_contract` — every metric family
+  minted through :mod:`veles_tpu.telemetry.registry` appears in the
+  docs/OBSERVABILITY.md catalog, label values come from bounded sets
+  (no f-strings), and every series referenced by
+  ``telemetry/alerts.py`` DEFAULT_RULES resolves to a real family.
+* :mod:`veles_tpu.analysis.knobs` — every ``VELES_*`` env knob is
+  documented (docs/CONFIGURATION.md) and parsed through the shared
+  empty-string-safe :func:`veles_tpu.envknob.env_knob` helper.
+
+Pure stdlib ``ast`` — no third-party dependency, no imports of the
+analyzed code, finishes in seconds on the full tree. Findings carry
+``file:line`` plus a stable fingerprint (independent of line numbers)
+so the committed baseline (``scripts/lint_baseline.json``) survives
+unrelated edits. ``python -m veles_tpu.analysis`` runs everything;
+``scripts/lint_gate.py`` is the CI gate (mirrors ``perf_gate.py``:
+hard-fails on any finding not in the baseline, and CI proves the gate
+can fail by running it against a known-bad fixture).
+"""
+
+from veles_tpu.analysis.core import (  # noqa: F401
+    Finding, Module, Project, load_baseline, run_all, write_baseline)

@@ -1,0 +1,131 @@
+"""``python -m veles_tpu.analysis`` — run the checkers, apply the
+baseline, exit non-zero on any unsuppressed finding.
+
+Default invocation analyzes the full ``veles_tpu/`` tree against the
+committed baseline and the doc contracts; this is what the CI lint
+gate runs (``scripts/lint_gate.py`` adds the gate bookkeeping on top,
+mirroring ``perf_gate.py``).
+"""
+
+import argparse
+import os
+import sys
+
+from veles_tpu.analysis import core
+
+DOC_FILES = ("docs/OBSERVABILITY.md", "docs/CONFIGURATION.md",
+             "docs/STATIC_ANALYSIS.md", "docs/TELEMETRY.md",
+             "docs/SERVING.md", "docs/ELASTIC.md", "docs/GSPMD.md",
+             "docs/PERF.md", "README.md")
+
+#: non-package files that legitimately mint metrics / read knobs —
+#: scanned so set-difference checks (MET004) see the whole story
+AUX_FILES = ("bench.py", "scripts")
+
+
+def repo_root_of(path):
+    """Nearest ancestor of ``path`` containing veles_tpu/ (the repo
+    checkout the doc contracts live in)."""
+    path = os.path.abspath(path)
+    while True:
+        if os.path.isdir(os.path.join(path, "veles_tpu")):
+            return path
+        parent = os.path.dirname(path)
+        if parent == path:
+            return os.getcwd()
+        path = parent
+
+
+def build_project(paths, repo_root, complete=None):
+    if complete is None:
+        # a run over the whole package may assert set-difference
+        # contracts (docs naming dead code); partial runs must not
+        complete = any(
+            os.path.abspath(p) == os.path.join(repo_root, "veles_tpu")
+            for p in paths)
+    docs = [os.path.join(repo_root, d) for d in DOC_FILES]
+    aux = [os.path.join(repo_root, a) for a in AUX_FILES]
+    return core.Project.load(
+        paths, repo_root, doc_paths=docs,
+        aux_paths=[a for a in aux if os.path.exists(a)],
+        complete=complete)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m veles_tpu.analysis",
+        description="veles-analyze: lock-order, tracer-hygiene and "
+                    "contract-drift checkers")
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help="files/dirs to analyze (default: the veles_tpu package)")
+    parser.add_argument(
+        "--baseline", default=None, metavar="JSON",
+        help="suppression baseline "
+             "(default scripts/lint_baseline.json)")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, suppressing nothing")
+    parser.add_argument(
+        "--write-baseline", metavar="JSON",
+        help="write current findings as a suppression baseline "
+             "(requires --reason) and exit 0")
+    parser.add_argument(
+        "--reason", default="",
+        help="reason recorded on every suppression --write-baseline "
+             "emits")
+    parser.add_argument(
+        "--checker", action="append", dest="checkers",
+        choices=("locks", "tracer", "metrics", "knobs"),
+        help="run only this checker (repeatable; default all)")
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text")
+    args = parser.parse_args(argv)
+
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    repo_root = repo_root_of(here)
+    paths = args.paths or [os.path.join(repo_root, "veles_tpu")]
+    project = build_project(paths, repo_root)
+    findings = core.run_all(project, args.checkers)
+
+    if args.write_baseline:
+        if not args.reason.strip():
+            parser.error("--write-baseline requires --reason "
+                         "(every suppression must say why)")
+        core.write_baseline(args.write_baseline, findings, args.reason)
+        print("wrote %d suppression(s) to %s"
+              % (len(findings), args.write_baseline))
+        return 0
+
+    baseline = {}
+    if not args.no_baseline:
+        baseline_path = args.baseline or os.path.join(
+            repo_root, "scripts", "lint_baseline.json")
+        baseline = core.load_baseline(baseline_path)
+    new, suppressed, stale = core.apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        import json
+        print(json.dumps({
+            "new": [f.render() for f in new],
+            "suppressed": [f.render() for f in suppressed],
+            "stale_suppressions": stale,
+            "files_analyzed": len(project.modules),
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if suppressed:
+            print("-- %d baseline-suppressed finding(s) not shown "
+                  "(see scripts/lint_baseline.json)" % len(suppressed))
+        for fp in stale:
+            print("-- stale suppression %s: no checker produces it "
+                  "any more — remove it from the baseline" % fp)
+        print("veles-analyze: %d file(s), %d finding(s), %d new"
+              % (len(project.modules), len(findings), len(new)))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

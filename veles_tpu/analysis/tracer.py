@@ -1,0 +1,279 @@
+"""JAX tracer-hygiene checker.
+
+A function traced by ``jit`` / ``pallas_call`` / ``scan`` /
+``custom_vjp`` runs its Python body ONCE per compile cache entry.
+Host-impure operations inside it don't fail — they silently bake the
+trace-time value into the compiled program and never run again on
+cache hits, which is how "the timestamp metric stopped moving" and
+"np.random gives the same draw every step" bugs are born. These are
+invisible to tests (first call looks right) — exactly what static
+analysis is for.
+
+Codes:
+
+* **TRACE001** ``print(...)`` — runs at trace time only; use
+  ``jax.debug.print`` for per-execution output.
+* **TRACE002** ``time.*()`` — freezes one wall-clock read into the
+  program.
+* **TRACE003** ``numpy.random.*`` / stdlib ``random.*`` — one draw,
+  reused forever; use ``jax.random`` with explicit keys
+  (:mod:`veles_tpu.prng`).
+* **TRACE004** ``.item()`` / ``float(tracer)``-style host sync — a
+  concretization error at best, a silent constant at worst.
+* **TRACE005** mutation of captured state (``self.x = ...``,
+  ``captured_list.append(...)``) — happens once at trace time, not
+  per step.
+* **TRACE006** ``os.environ`` reads — bakes the trace-time
+  environment into compiled code; read knobs outside and pass values
+  in.
+
+Roots are found from decorators (``@jax.jit``,
+``@functools.partial(jax.jit, ...)``, ``@jax.custom_vjp``), wrapper
+calls (``jax.jit(f)``, ``pl.pallas_call(kernel, ...)``,
+``jax.lax.scan/while_loop/cond/fori_loop`` body arguments,
+``f.defvjp(fwd, bwd)``), then taint-propagated through calls to
+functions defined in the same module. Calls routed through the
+sanctioned escape hatches (``jax.debug.print``, ``jax.debug.callback``,
+``jax.pure_callback``, ``jax.experimental.io_callback``) are exempt.
+"""
+
+import ast
+
+from veles_tpu.analysis.core import (
+    Finding, dotted_name, import_aliases, resolve_call)
+from veles_tpu.analysis.locks import MUTATORS
+
+#: decorators that make the decorated function a traced root
+TRACING_DECORATORS = frozenset((
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.custom_vjp", "jax.custom_jvp", "jax.checkpoint", "jax.remat",
+))
+
+#: wrapper call -> positional args that are traced callables
+WRAPPER_ARGS = {
+    "jax.jit": (0,), "jax.pmap": (0,), "jax.vmap": (0,),
+    "jax.grad": (0,), "jax.value_and_grad": (0,),
+    "jax.custom_vjp": (0,), "jax.custom_jvp": (0,),
+    "jax.checkpoint": (0,), "jax.remat": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.experimental.pallas.pallas_call": (0,),
+    "jax.experimental.shard_map.shard_map": (0,),
+}
+
+#: calls whose arguments are the sanctioned host-callback escape hatch
+CALLBACK_OK = frozenset((
+    "jax.debug.print", "jax.debug.callback", "jax.pure_callback",
+    "jax.experimental.io_callback", "jax.debug.breakpoint",
+))
+
+#: canonical impure call prefixes -> finding code
+IMPURE_PREFIXES = (
+    ("time.", "TRACE002", "wall-clock read"),
+    ("numpy.random.", "TRACE003", "host RNG draw"),
+    ("random.", "TRACE003", "host RNG draw"),
+)
+
+ENV_READS = frozenset(("os.environ.get", "os.getenv"))
+
+
+def _decorator_roots(func, aliases):
+    """True when one of ``func``'s decorators traces it."""
+    for dec in func.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = resolve_call(ast.Call(func=target, args=[], keywords=[]),
+                            aliases)
+        if name in TRACING_DECORATORS:
+            return True
+        # functools.partial(jax.jit, static_argnums=...)
+        if name == "functools.partial" and isinstance(dec, ast.Call) \
+                and dec.args:
+            inner = resolve_call(
+                ast.Call(func=dec.args[0], args=[], keywords=[]),
+                aliases)
+            if inner in TRACING_DECORATORS:
+                return True
+    return False
+
+
+def _collect_functions(tree):
+    """Every function def in the module, keyed by (qualname is not
+    needed — taint resolves by local/bare name)."""
+    funcs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, []).append(node)
+    return funcs
+
+
+def _callable_name(node):
+    """Bare name of a callable reference in an argument position."""
+    if isinstance(node, ast.Name):
+        return node.id
+    attr = dotted_name(node)
+    if attr and attr.startswith("self."):
+        return attr.split(".", 1)[1]
+    return None
+
+
+def _find_roots(tree, aliases, funcs):
+    roots = {}
+
+    def add(name, why):
+        if name in funcs and name not in roots:
+            roots[name] = why
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _decorator_roots(node, aliases):
+                roots.setdefault(node.name, "decorated traced function")
+        elif isinstance(node, ast.Call):
+            target = resolve_call(node, aliases)
+            if target in WRAPPER_ARGS:
+                for pos in WRAPPER_ARGS[target]:
+                    if pos < len(node.args):
+                        name = _callable_name(node.args[pos])
+                        if name:
+                            add(name, "passed to %s" % target)
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "defvjp":
+                for arg in node.args:
+                    name = _callable_name(arg)
+                    if name:
+                        add(name, "custom_vjp rule")
+    return roots
+
+
+def _taint(roots, funcs):
+    """Propagate traced-ness through same-module calls."""
+    traced = dict(roots)
+    queue = list(roots)
+    while queue:
+        name = queue.pop()
+        for node in funcs.get(name, ()):
+            for call in [n for n in ast.walk(node)
+                         if isinstance(n, ast.Call)]:
+                callee = _callable_name(call.func)
+                if callee in funcs and callee not in traced:
+                    traced[callee] = "called from traced %s" % name
+                    queue.append(callee)
+    return traced
+
+
+def _local_names(func):
+    """Names bound inside ``func`` (params + assignments): mutating
+    these at trace time is fine — they are trace-local."""
+    names = set()
+    args = func.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    names.add(sub.id)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            names.add(node.name)
+    return names
+
+
+def _scan_traced(mod, func, why, aliases, findings):
+    locals_ = _local_names(func)
+    skip = set()   # nodes inside sanctioned callback calls
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) \
+                and resolve_call(node, aliases) in CALLBACK_OK:
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+
+    def emit(code, line, what, key_tail):
+        findings.append(Finding(
+            "tracer", code, mod.relpath, line,
+            "%s inside traced %s (%s)" % (what, func.name, why),
+            key="%s.%s" % (func.name, key_tail)))
+
+    for node in ast.walk(func):
+        if id(node) in skip or node is func:
+            continue
+        if isinstance(node, ast.Call):
+            target = resolve_call(node, aliases)
+            if target == "print":
+                emit("TRACE001", node.lineno,
+                     "print() runs at trace time only", "print")
+                continue
+            if target in ENV_READS:
+                emit("TRACE006", node.lineno,
+                     "os.environ read bakes trace-time env in",
+                     "environ")
+                continue
+            if target:
+                matched = False
+                for prefix, code, what in IMPURE_PREFIXES:
+                    if target.startswith(prefix):
+                        emit(code, node.lineno,
+                             "%s %s() freezes one value" % (
+                                 what, target), target)
+                        matched = True
+                        break
+                if matched:
+                    continue
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "item" and not node.args:
+                    emit("TRACE004", node.lineno,
+                         ".item() host sync", "item")
+                    continue
+                recv = node.func.value
+                if node.func.attr in MUTATORS:
+                    recv_name = dotted_name(recv)
+                    if recv_name and recv_name.split(".")[0] \
+                            not in locals_:
+                        emit("TRACE005", node.lineno,
+                             "mutation of captured %r happens once "
+                             "at trace time" % recv_name,
+                             "mut.%s" % recv_name)
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            target = dotted_name(node.value)
+            if target == "os.environ":
+                emit("TRACE006", node.lineno,
+                     "os.environ read bakes trace-time env in",
+                     "environ")
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                base = tgt.value if isinstance(tgt, ast.Subscript) \
+                    else tgt
+                name = dotted_name(base)
+                if name and "." in name \
+                        and name.split(".")[0] == "self":
+                    emit("TRACE005", tgt.lineno,
+                         "write to captured %s happens once at "
+                         "trace time" % name, "set.%s" % name)
+
+
+def check(project):
+    findings = []
+    for mod in project.modules:
+        if mod.tree is None:
+            continue
+        aliases = import_aliases(mod.tree)
+        funcs = _collect_functions(mod.tree)
+        roots = _find_roots(mod.tree, aliases, funcs)
+        if not roots:
+            continue
+        traced = _taint(roots, funcs)
+        for name, why in sorted(traced.items()):
+            for func in funcs[name]:
+                _scan_traced(mod, func, why, aliases, findings)
+    return findings

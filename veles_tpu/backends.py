@@ -23,6 +23,7 @@ import os
 import threading
 
 from veles_tpu.config import root
+from veles_tpu.envknob import env_knob
 from veles_tpu.logger import Logger
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 
@@ -41,7 +42,7 @@ class BackendRegistry(CommandLineArgumentsRegistry):
 
 def resolve_backend(name=None):
     """Resolve a backend name, expanding ``auto`` by priority."""
-    name = (name or os.environ.get("VELES_TPU_BACKEND") or
+    name = (name or env_knob("VELES_TPU_BACKEND") or
             root.common.engine.get("backend", "auto"))
     if name == "auto":
         for candidate in ("tpu", "cpu", "numpy"):
@@ -310,7 +311,7 @@ class CPUDevice(JaxDevice):
         # (calling jax.default_backend() here would — and block on a
         # busy relay).
         if (not initialized and
-                os.environ.get("VELES_TPU_BACKEND") in ("cpu", "numpy")
+                env_knob("VELES_TPU_BACKEND") in ("cpu", "numpy")
                 and (jax.config.jax_platforms or "") != "cpu"):
             jax.config.update("jax_platforms", "cpu")
         super(CPUDevice, self).__init__(**kwargs)

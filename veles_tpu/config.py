@@ -14,6 +14,8 @@ import os
 import runpy
 import threading
 
+from veles_tpu.envknob import env_knob
+
 
 class Config(object):
     """One node of the configuration tree.
@@ -154,13 +156,13 @@ def _init_defaults():
             "datasets": os.path.join(home, "datasets"),
         },
         "engine": {
-            "backend": os.environ.get("VELES_TPU_BACKEND", "auto"),
+            "backend": env_knob("VELES_TPU_BACKEND", "auto"),
             # fp precision policy: compute dtype for MXU matmuls and the
             # accumulation discipline replacing the reference's
             # PRECISION_LEVEL 0/1/2 (``veles/config.py:244-248``).
-            "precision_type": os.environ.get("VELES_PRECISION", "float32"),
-            "precision_level": int(os.environ.get("VELES_PRECISION_LEVEL",
-                                                  "0")),
+            "precision_type": env_knob("VELES_PRECISION", "float32"),
+            "precision_level": env_knob("VELES_PRECISION_LEVEL", 0,
+                                        parse=int),
         },
         "trace": {"run": False, "misprints": False},
         "timings": False,

@@ -101,12 +101,12 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         #: beat's RTT, aggregated on the master per slave)
         self.heartbeat_interval = kwargs.get("heartbeat_interval", 2.0)
         self.max_idle = kwargs.get("max_idle")
-        import os as os_mod
+        from veles_tpu.envknob import env_knob
         #: fault-tolerance knobs (ISSUE 12, docs/FAULT_TOLERANCE.md):
         #: auto_resume = snapshot directory the master checkpoints to
         #: on every epoch close and restores from on restart
         self.auto_resume = kwargs.get("auto_resume") or \
-            os_mod.environ.get("VELES_AUTO_RESUME") or None
+            env_knob("VELES_AUTO_RESUME")
         #: master: drop (and requeue the jobs of) a slave held in the
         #: health scorer's straggler state this long (None = alert
         #: only). None-aware fallbacks throughout: the CLI always
@@ -114,7 +114,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         #: plain dict.get default would shadow the env knobs
         drop_s = kwargs.get("straggler_drop_s")
         if drop_s is None:
-            drop_s = os_mod.environ.get("VELES_STRAGGLER_DROP_S")
+            drop_s = env_knob("VELES_STRAGGLER_DROP_S", parse=float)
         self.straggler_drop_s = None if drop_s in (None, "") \
             else float(drop_s)
         #: slave: on master loss mid-run, re-handshake with exponential
@@ -122,7 +122,8 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         #: restarted master needs to restore its snapshot and re-bind)
         reconnect_s = kwargs.get("reconnect_s")
         if reconnect_s in (None, ""):
-            reconnect_s = os_mod.environ.get("VELES_RECONNECT_S") or 30.0
+            reconnect_s = env_knob("VELES_RECONNECT_S", 30.0,
+                                   parse=float)
         self.reconnect_s = float(reconnect_s)
         self._resumed_from = None
         self._resume_complete = False
@@ -141,10 +142,9 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
         #: gradient merge is a compiler-inserted psum instead of the
         #: coordinator's host-mediated exchange. None/"" = off.
         #: VELES_GSPMD env is the fallback (the bench legs use it).
-        import os as _os
         gspmd = kwargs.get("gspmd")
         if gspmd in (None, ""):
-            gspmd = _os.environ.get("VELES_GSPMD") or None
+            gspmd = env_knob("VELES_GSPMD")
         self.gspmd = gspmd
         #: minibatches per distributed job (1 = reference-style);
         #: segments amortize the round-trip + weight exchange
@@ -174,8 +174,7 @@ class Launcher(Logger, metaclass=CommandLineArgumentsRegistry):
                 # suppressing the no-secret warning)
                 self.secret = fin.read().strip() or None
         if self.secret is None:
-            import os as os_mod
-            self.secret = os_mod.environ.get("VELES_TPU_SECRET") or None
+            self.secret = env_knob("VELES_TPU_SECRET")
         #: per-connection binary frame cap (MB); the 256 MB default
         #: covers AlexNet-scale weight pickles, VGG-scale needs more
         mb = kwargs.get("max_frame_mb")

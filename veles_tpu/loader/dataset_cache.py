@@ -30,6 +30,8 @@ import shutil
 
 import numpy
 
+from veles_tpu.envknob import env_flag
+
 #: bump to invalidate every cached dataset at once
 CACHE_VERSION = 1
 
@@ -37,8 +39,7 @@ _log = logging.getLogger("dataset_cache")
 
 
 def enabled():
-    return os.environ.get("VELES_DATASET_CACHE", "rw") not in (
-        "0", "off", "no")
+    return env_flag("VELES_DATASET_CACHE", True)
 
 
 def config_hash(config):

@@ -41,13 +41,13 @@ deliberately slow loader that ``scripts/input_bench.py`` and the perf
 gate's overlap probe use to measure (not assert) the overlap win.
 """
 
-import os
 import threading
 import time
 import weakref
 
 import numpy
 
+from veles_tpu.envknob import env_knob
 from veles_tpu.telemetry import tracing
 
 #: live pipelines (weak): the conftest session teardown closes any a
@@ -58,28 +58,21 @@ _live = weakref.WeakSet()
 
 def default_depth():
     """``VELES_PREFETCH`` (default 2; 0 = synchronous)."""
-    try:
-        return max(0, int(os.environ.get("VELES_PREFETCH", "2")))
-    except ValueError:
-        return 2
+    return max(0, env_knob("VELES_PREFETCH", 2, parse=int,
+                           on_error="default"))
 
 
 def default_workers():
     """``VELES_PREFETCH_WORKERS`` ETL threads (default 1)."""
-    try:
-        return max(1, int(os.environ.get("VELES_PREFETCH_WORKERS", "1")))
-    except ValueError:
-        return 1
+    return max(1, env_knob("VELES_PREFETCH_WORKERS", 1, parse=int,
+                           on_error="default"))
 
 
 def etl_throttle_s():
     """Injected per-shard ETL sleep (``VELES_ETL_THROTTLE_MS``) — the
     slow-loader simulation knob for benches/tests; 0 in production."""
-    try:
-        return max(0.0, float(
-            os.environ.get("VELES_ETL_THROTTLE_MS", "0"))) / 1e3
-    except ValueError:
-        return 0.0
+    return max(0.0, env_knob("VELES_ETL_THROTTLE_MS", 0.0, parse=float,
+                             on_error="default")) / 1e3
 
 
 def _registry():
@@ -381,13 +374,10 @@ def device_budget_bytes(device=None):
     device's reported ``bytes_limit`` (params, activations and XLA
     scratch need the rest); else None (unknown — stay resident, the
     pre-pipeline behavior)."""
-    env = os.environ.get("VELES_DEVICE_BUDGET_MB")
-    if env:
-        try:
-            mb = float(env)
-            return mb * 1e6 if mb > 0 else None
-        except ValueError:
-            pass
+    mb = env_knob("VELES_DEVICE_BUDGET_MB", parse=float,
+                  on_error="default")
+    if mb is not None:
+        return mb * 1e6 if mb > 0 else None
     stats = {}
     try:
         if device is not None and getattr(device, "is_jax", False):
@@ -409,7 +399,7 @@ def plan_residency(dataset_bytes, device=None, force=None):
     always, ``0``/``off``/``no`` never; anything else is ignored and
     the budget decides) overrides the budget comparison."""
     if force is None:
-        env = os.environ.get("VELES_STREAM")
+        env = env_knob("VELES_STREAM")
         if env in ("1", "force", "on", "yes", "true"):
             force = True
         elif env in ("0", "off", "no", "false"):
@@ -428,10 +418,8 @@ def shard_batches(batch_bytes, depth=None, budget_bytes=None):
     Targets ``VELES_SHARD_MB`` (default 256) per shard, shrunk so the
     ring's ``depth + 2`` resident shards still fit the device budget
     when one is known."""
-    try:
-        target = float(os.environ.get("VELES_SHARD_MB", "256")) * 1e6
-    except ValueError:
-        target = 256e6
+    target = env_knob("VELES_SHARD_MB", 256.0, parse=float,
+                      on_error="default") * 1e6
     depth = default_depth() if depth is None else depth
     if budget_bytes:
         target = min(target, budget_bytes / (depth + 2))

@@ -105,6 +105,10 @@ class Array(object):
         return self.mem[index]
 
     def __setitem__(self, index, value):
+        """Element write. ``map_write`` syncs coherence state under
+        the lock; the element store itself is not thread-safe by
+        design — the lock protects the coherence protocol, not
+        concurrent host mutation of the same buffer."""
         self.map_write()
         self.mem[index] = value
 
@@ -167,6 +171,8 @@ class Array(object):
                 self._accounted_ = new
 
     def _upload(self):
+        """Host -> device copy + accounting. Caller holds
+        ``self._lock_``."""
         old = self._accounted_
         self._devmem_ = self.device.put(self.mem)
         self._accounted_ = self.nbytes
@@ -177,6 +183,8 @@ class Array(object):
         self._state_ = CLEAN
 
     def _drop_devmem(self):
+        """Release the device buffer + accounting. Caller holds
+        ``self._lock_``."""
         if self._accounted_:
             watcher.remove(self._accounted_)
             self._accounted_ = 0
@@ -212,6 +220,7 @@ class Array(object):
         return self.mem
 
     def _ensure_writable(self):
+        """Caller holds ``self._lock_``."""
         # device→host views (numpy.asarray of a jax.Array) are read-only;
         # a host write mapping must always hand out a mutable buffer
         if self.mem is not None and not self.mem.flags.writeable:

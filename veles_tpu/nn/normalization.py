@@ -47,8 +47,8 @@ def lrn(x, k=2.0, alpha=1e-4, beta=0.75, n=5):
     blocking is now shape-tuned through the autotune cache
     (``lrn_fwd``/``lrn_bwd`` entries), so re-runs of the ablation pick
     each shape's measured best block instead of the fixed 512."""
-    import os
-    force = os.environ.get("VELES_LRN", "xla")
+    from veles_tpu.envknob import env_knob
+    force = env_knob("VELES_LRN", "xla")
     on_tpu = jax.default_backend() == "tpu"
     if x.ndim == 4 and n % 2 == 1 and force == "pallas":
         from veles_tpu.ops.lrn import lrn_fused

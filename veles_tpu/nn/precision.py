@@ -25,12 +25,11 @@ needed — but a FusedTrainer built under one policy keeps it for its
 lifetime, matching how the reference pinned precision per run.
 """
 
-import os
-
 import jax.numpy as jnp
 
 from veles_tpu.cmdline import CommandLineArgumentsRegistry
 from veles_tpu.config import root
+from veles_tpu.envknob import env_knob
 
 
 class Policy(object):
@@ -73,7 +72,7 @@ def get_policy():
     config tree > float32."""
     if _forced is not None:
         return _forced
-    name = os.environ.get("VELES_PRECISION") or \
+    name = env_knob("VELES_PRECISION") or \
         root.common.engine.get("precision", "float32")
     try:
         return POLICIES[name]

@@ -48,6 +48,7 @@ import time
 import numpy
 
 from veles_tpu.config import root
+from veles_tpu.envknob import env_knob
 from veles_tpu.telemetry import tracing
 from veles_tpu.telemetry.registry import get_registry
 
@@ -69,7 +70,7 @@ _warned_corrupt = set()
 
 def mode():
     """Resolve the tuning mode. Env knob wins over the config tree."""
-    m = os.environ.get("VELES_AUTOTUNE")
+    m = env_knob("VELES_AUTOTUNE")
     if not m:
         m = root.common.engine.get("autotune", "cache")
     return m if m in _MODES else "cache"
@@ -78,7 +79,7 @@ def mode():
 def forced_interpret():
     """True when VELES_AUTOTUNE_FORCE requests interpret-mode kernels
     (the CPU test/CI path through the full search machinery)."""
-    return os.environ.get("VELES_AUTOTUNE_FORCE", "") in ("1", "interpret")
+    return env_knob("VELES_AUTOTUNE_FORCE") in ("1", "interpret")
 
 
 def _on_tpu():
@@ -123,7 +124,7 @@ def device_kind():
 
 
 def cache_path():
-    explicit = os.environ.get("VELES_AUTOTUNE_CACHE")
+    explicit = env_knob("VELES_AUTOTUNE_CACHE")
     if explicit:
         return explicit
     from veles_tpu.backends import veles_cache_dir
@@ -181,6 +182,7 @@ class AutotuneCache(object):
             return {}
 
     def _ensure(self):
+        """Lazy first load. Caller holds ``self._lock``."""
         if self._entries is None:
             self._entries = self._read_disk()
         return self._entries
@@ -277,7 +279,7 @@ def _measure(fn, args, iters=None):
     import jax.numpy as jnp
 
     if iters is None:
-        iters = int(os.environ.get("VELES_AUTOTUNE_ITERS", "10"))
+        iters = env_knob("VELES_AUTOTUNE_ITERS", 10, parse=int)
 
     def body(c, _):
         out = fn(args[0] + c.astype(args[0].dtype), *args[1:])
@@ -351,7 +353,7 @@ def _plan(op, fields, candidates_fn, runner_fn, flops=None,
 def _search(op, key, candidates, runner_fn, flops, shape_label):
     searches, hits, misses, best_gauge = _metrics()
     searches.inc()
-    budget = float(os.environ.get("VELES_AUTOTUNE_BUDGET_S", "20"))
+    budget = env_knob("VELES_AUTOTUNE_BUDGET_S", 20.0, parse=float)
     results = []
     with tracing.span("autotune:search", op=op, key=key):
         t0 = time.perf_counter()

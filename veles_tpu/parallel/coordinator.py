@@ -32,6 +32,7 @@ import time
 import uuid
 
 from veles_tpu import prng
+from veles_tpu.envknob import env_flag, env_knob
 from veles_tpu.logger import Logger
 from veles_tpu.parallel import wire
 from veles_tpu.telemetry import federation, health, tracing
@@ -749,6 +750,9 @@ class CoordinatorServer(Logger):
         self.drop_slave(sid, reason=reason)
 
     def drop_slave(self, sid, reason="disconnect"):
+        """Unregister a slave and requeue its in-flight jobs. Caller
+        holds ``self._lock`` (the reaper and the serve loop both
+        enter here under it)."""
         slave = self.slaves.pop(sid, None)
         if slave is not None:
             # the federated feed and health row describe a LIVE slave:
@@ -1161,21 +1165,20 @@ class CoordinatorClient(Logger):
         #: up — the window a restarted master needs to restore from
         #: its latest snapshot and re-bind. 0/None = die like before.
         if reconnect_s is None:
-            # `or 0`: an empty-string env var means unset, not float("")
-            reconnect_s = float(
-                os.environ.get("VELES_RECONNECT_S") or 0)
+            reconnect_s = env_knob("VELES_RECONNECT_S", 0.0,
+                                   parse=float)
         self.reconnect_s = reconnect_s
         #: same budget for the INITIAL connect: a slave started before
         #: its master must not die on ConnectionRefused
         if connect_retry_s is None:
-            connect_retry_s = float(
-                os.environ.get("VELES_CONNECT_RETRY_S") or 0)
+            connect_retry_s = env_knob("VELES_CONNECT_RETRY_S", 0.0,
+                                       parse=float)
         self.connect_retry_s = connect_retry_s
         #: backoff shape: base * 2^n, each sleep jittered to 50-150%
         #: so a whole fleet reconnecting to a restarted master does
         #: not dial in lockstep
-        self.backoff_base_s = float(
-            os.environ.get("VELES_RECONNECT_BASE_S") or 0.25)
+        self.backoff_base_s = env_knob("VELES_RECONNECT_BASE_S", 0.25,
+                                       parse=float)
         #: called with this client after every successful MID-RUN
         #: reconnect (the launcher re-applies the master's initial
         #: data / resync state through it)
@@ -1191,7 +1194,7 @@ class CoordinatorClient(Logger):
         #: the master can serve ONE federated /metrics for the cluster
         #: (VELES_FEDERATION=0 turns the piggyback off fleet-wide)
         if federate is None:
-            federate = os.environ.get("VELES_FEDERATION", "1") != "0"
+            federate = env_flag("VELES_FEDERATION", True)
         self.federate = federate
         self._snapshot_encoder = None
         #: flight-record notices queued for the next beat (bounded: an

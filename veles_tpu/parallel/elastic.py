@@ -68,6 +68,7 @@ import sys
 import threading
 import time
 
+from veles_tpu.envknob import env_knob
 from veles_tpu.logger import Logger
 from veles_tpu.parallel.retry import retry_with_backoff
 
@@ -296,6 +297,8 @@ class RendezvousServer(Logger):
         return {"status": "error", "error": "unknown cmd %r" % cmd}
 
     def _join(self, member):
+        """Register/refresh a member. Caller holds ``self._lock``
+        (every ``_handle`` dispatch runs under it)."""
         if self.phase == "done":
             return {"status": "done"}
         state = self._members.get(member)
@@ -379,7 +382,8 @@ class RendezvousServer(Logger):
     def _break_generation(self, reason, lost=True):
         """A participant of the RUNNING generation is gone (or a join
         must be absorbed): bump the generation and send every
-        survivor back through rendezvous."""
+        survivor back through rendezvous. Caller holds
+        ``self._lock``."""
         if self.phase != "running":
             return
         if lost:
@@ -786,15 +790,15 @@ def worker_context():
     """The :class:`ElasticContext` from ``VELES_ELASTIC_*`` env, or
     ``None`` when this process is not supervised (plain standalone
     training — every elastic code path degrades to a no-op)."""
-    world = os.environ.get(ENV_WORLD)
+    world = env_knob(ENV_WORLD)
     if not world:
         return None
     return ElasticContext(
-        generation=os.environ.get(ENV_GEN, 0),
+        generation=env_knob(ENV_GEN, 0),
         world_size=world,
-        rank=os.environ.get(ENV_RANK, 0),
-        coordinator=os.environ.get(ENV_COORD),
-        snapshot_dir=os.environ.get(ENV_SNAPSHOTS))
+        rank=env_knob(ENV_RANK, 0),
+        coordinator=env_knob(ENV_COORD),
+        snapshot_dir=env_knob(ENV_SNAPSHOTS))
 
 
 def init_distributed(ctx):
@@ -812,7 +816,7 @@ def init_distributed(ctx):
 
 
 def _test_die_hook(ctx, trainer):
-    spec = os.environ.get(ENV_TEST_DIE)
+    spec = env_knob(ENV_TEST_DIE)
     if not spec or ctx is None:
         return
     rank, _, epochs = spec.partition(":")

@@ -167,9 +167,9 @@ def init_multihost(coordinator_address=None, num_processes=None,
     except Exception:
         pass  # older jaxlib: single-platform behavior unchanged
     if retry_budget_s is None:
-        import os
-        env = os.environ.get("VELES_MESH_INIT_RETRY_S", "")
-        retry_budget_s = float(env) if env else 60.0
+        from veles_tpu.envknob import env_knob
+        retry_budget_s = env_knob("VELES_MESH_INIT_RETRY_S", 60.0,
+                                  parse=float)
 
     def non_retryable(e):
         # non-transport failures can never succeed on retry: an

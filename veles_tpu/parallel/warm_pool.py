@@ -29,6 +29,7 @@ import subprocess
 import sys
 import threading
 
+from veles_tpu.envknob import env_knob
 from veles_tpu.logger import Logger
 
 
@@ -37,7 +38,7 @@ def _worker_main():
     proto_out = os.fdopen(os.dup(sys.stdout.fileno()), "w", buffering=1)
     os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
     sys.stdout = sys.stderr
-    if os.environ.get("VELES_TPU_BACKEND") in ("cpu", "numpy"):
+    if env_knob("VELES_TPU_BACKEND") in ("cpu", "numpy"):
         # flip the platform BEFORE anything touches jax: sitecustomize
         # may pin a TPU-relay platform that the env var alone cannot
         # undo, and initializing it here would block the worker behind
@@ -173,10 +174,16 @@ class WarmPool(Logger):
         return reply
 
     def close(self):
-        for worker in self._workers:
+        # empty the pool under the lock and WAKE waiters (a run()
+        # blocked on an empty free list would otherwise sleep forever);
+        # worker shutdown happens outside it — close() blocks up to
+        # 10 s per worker
+        with self._cv:
+            workers, self._workers = self._workers, []
+            self._free = []
+            self._cv.notify_all()
+        for worker in workers:
             worker.close()
-        self._workers = []
-        self._free = []
 
 
 if __name__ == "__main__":

@@ -46,9 +46,10 @@ class RandomGenerator(object):
         elif isinstance(seed, (bytes, bytearray)):
             seed = int.from_bytes(
                 hashlib.sha256(bytes(seed)).digest()[:8], "little")
-        self.seed_value = int(seed) & 0xFFFFFFFF
-        self.state = numpy.random.RandomState(self.seed_value)
-        self._jax_counter = 0
+        with self._lock:
+            self.seed_value = int(seed) & 0xFFFFFFFF
+            self.state = numpy.random.RandomState(self.seed_value)
+            self._jax_counter = 0
         return self
 
     # -- host-side sampling ------------------------------------------------
@@ -103,13 +104,18 @@ class RandomGenerator(object):
     # -- state management ---------------------------------------------------
 
     def save_state(self):
-        return (self.state.get_state(), self._jax_counter, self.seed_value)
+        with self._lock:
+            return (self.state.get_state(), self._jax_counter,
+                    self.seed_value)
 
     def restore_state(self, saved):
         state, counter, seed_value = saved
-        self.state.set_state(state)
-        self._jax_counter = counter
-        self.seed_value = seed_value
+        # under the lock: a sampler racing a checkpoint restore must
+        # see the old state or the new one, never half of each
+        with self._lock:
+            self.state.set_state(state)
+            self._jax_counter = counter
+            self.seed_value = seed_value
 
     def __getstate__(self):
         return {"key": self.key, "seed_value": self.seed_value,

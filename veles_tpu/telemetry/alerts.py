@@ -50,6 +50,7 @@ import os
 import threading
 import time
 
+from veles_tpu.envknob import env_knob
 from veles_tpu.telemetry.registry import get_registry
 
 log = logging.getLogger("veles.alerts")
@@ -487,7 +488,7 @@ def get_engine():
     with _engine_lock:
         if _engine is None:
             _engine = AlertEngine()
-            path = os.environ.get("VELES_ALERT_RULES")
+            path = env_knob("VELES_ALERT_RULES")
             if path:
                 try:
                     _engine.load_rules(path)

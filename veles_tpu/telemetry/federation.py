@@ -173,7 +173,8 @@ class FederatedRegistry(object):
     def set_run_info(self, **info):
         """Attach run-level context (trace id, master id) that
         ``cluster_report()`` surfaces."""
-        self.run_info.update(info)
+        with self._lock:
+            self.run_info.update(info)
 
     # -- merging -----------------------------------------------------------
 

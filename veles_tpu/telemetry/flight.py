@@ -38,6 +38,7 @@ import os
 import threading
 import time
 
+from veles_tpu.envknob import env_knob
 from veles_tpu.telemetry import tracing
 from veles_tpu.telemetry.registry import Reservoir, get_registry
 
@@ -46,10 +47,7 @@ SPAN_TAIL = 200
 
 
 def _env_float(name, default):
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
+    return env_knob(name, default, parse=float, on_error="default")
 
 
 class LogTail(logging.Handler):
@@ -80,7 +78,7 @@ class FlightRecorder(object):
                  stall_factor=None, stall_min_s=None,
                  grad_spike_factor=None, poll_s=1.0,
                  min_dump_interval_s=5.0):
-        self.out_dir = out_dir or os.environ.get(
+        self.out_dir = out_dir or env_knob(
             "VELES_FLIGHT_DIR", "flight_records")
         self.stall_factor = (stall_factor if stall_factor is not None
                              else _env_float("VELES_STALL_FACTOR", 10.0))
@@ -334,9 +332,12 @@ class FlightRecorder(object):
 
     def stop(self):
         self._watch_stop.set()
-        if self._watch_thread is not None:
-            self._watch_thread.join(timeout=5)
-            self._watch_thread = None
+        # swap under the lock, join outside it (the watcher takes the
+        # same lock to dump; joining while holding it would deadlock)
+        with self._lock:
+            thread, self._watch_thread = self._watch_thread, None
+        if thread is not None:
+            thread.join(timeout=5)
         logging.getLogger().removeHandler(self._log_tail)
 
 

@@ -234,6 +234,8 @@ class HealthScorer(object):
                     1.0 if st.state == "straggler" else 0.0)
 
     def _transition(self, sid, st, to, now):
+        """State flip + transition log. Caller holds ``self._lock``
+        (only ``evaluate`` enters here, under it)."""
         st.state = to
         st.since = now
         st.breach_streak = 0

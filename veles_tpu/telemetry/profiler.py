@@ -41,6 +41,7 @@ import os
 import threading
 import time
 
+from veles_tpu.envknob import env_flag, env_knob
 from veles_tpu.telemetry import tracing
 from veles_tpu.telemetry.registry import get_registry
 
@@ -63,14 +64,8 @@ DEVICE_SPECS = (
 def _env_positive(name):
     """float(env) or None — a typo'd override must degrade to
     "unknown peak" (no MFU/verdict), never unwind a training sweep."""
-    raw = os.environ.get(name)
-    if not raw:
-        return None
-    try:
-        value = float(raw)
-    except ValueError:
-        return None
-    return value if value > 0 else None
+    value = env_knob(name, parse=float, on_error="default")
+    return value if value is not None and value > 0 else None
 
 
 def device_spec(device=None):
@@ -96,8 +91,7 @@ def device_spec(device=None):
 
 
 def attribution_enabled():
-    return os.environ.get("VELES_COST_ATTRIBUTION", "1") not in (
-        "0", "off", "no")
+    return env_flag("VELES_COST_ATTRIBUTION", True)
 
 
 def _first(costs, *keys):
@@ -551,8 +545,8 @@ def start_memory_sampler(interval=None):
     global _sampler
     if interval is None:
         env = _env_positive("VELES_MEMORY_SAMPLE_S")
-        if env is None and os.environ.get(
-                "VELES_MEMORY_SAMPLE_S") is not None:
+        if env is None and \
+                env_knob("VELES_MEMORY_SAMPLE_S") is not None:
             return None  # explicit 0 / unparsable: sampling off
         interval = env if env is not None else 5.0
     if interval <= 0:

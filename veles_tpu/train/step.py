@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy
 
 from veles_tpu import prng
+from veles_tpu.envknob import env_flag, env_knob
 from veles_tpu.loader import prefetch
 from veles_tpu.loader.base import TEST, TRAIN, VALIDATION, CLASS_NAMES
 from veles_tpu.logger import Logger
@@ -99,8 +100,7 @@ class FusedTrainer(Logger):
         # update math is untouched
         self.track_grad_norms = (
             grad_norms if grad_norms is not None
-            else os.environ.get("VELES_GRAD_NORMS", "1") not in (
-                "0", "off", "no"))
+            else env_flag("VELES_GRAD_NORMS", True))
         #: (n_batches,) f32 norms of the most recent train segment,
         #: None until one ran (or when tracking is off)
         self.last_grad_norms = None
@@ -133,9 +133,9 @@ class FusedTrainer(Logger):
         aborts ~5/6 runs with donation on CPU, 0/6 with it off)."""
         if donate is not None:
             return donate
-        env = os.environ.get("VELES_DONATE")
+        env = env_flag("VELES_DONATE", None)
         if env is not None:
-            return env not in ("0", "off", "no")
+            return env
         import jax
         return jax.default_backend() != "cpu"
 
