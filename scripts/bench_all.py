@@ -200,7 +200,7 @@ def main():
     import bench  # repo-root bench.py: shared matmul-peak measurement
 
     names = sys.argv[1:] or list(CONFIGS) + [
-        "som", "serving", "serving-cache", "serving-burst"]
+        "som", "serving", "serving-cache", "serving-burst", "offload"]
     set_policy(PRECISION)
     peak = bench.measured_matmul_peak_tflops()
     print("chip matmul peak: %.1f TF/s, policy=%s, window>=%.0fs"
@@ -224,6 +224,24 @@ def main():
             print(bench_serving.markdown_row(result), flush=True)
             print("%s: %s in %.0fs total"
                   % (name, "PASS" if result["pass"] else "FAIL",
+                     time.time() - t0), file=sys.stderr)
+            continue
+        if name == "offload":
+            # the out-of-core model-state bench (ISSUE 17) has its own
+            # metric shape (transfer-wait ratio vs samples/s) —
+            # delegate like the serving scenarios and echo its summary
+            import subprocess
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(HERE, "scripts", "offload_bench.py"),
+                 "--transfer-ms", "12", "--epochs", "1"],
+                capture_output=True, text=True)
+            summary = next(
+                (line for line in proc.stdout.splitlines()[::-1]
+                 if '"summary"' in line), proc.stdout.strip())
+            print(summary, flush=True)
+            print("%s: %s in %.0fs total"
+                  % (name, "PASS" if proc.returncode == 0 else "FAIL",
                      time.time() - t0), file=sys.stderr)
             continue
         if name == "som":

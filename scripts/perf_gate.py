@@ -139,6 +139,64 @@ def _input_pipeline_probe():
             "input_wait_overlap_ratio": sync_ms / max(deep_ms, 1e-9)}
 
 
+def _offload_probe():
+    """ISSUE 17 overlap guard: a tiny host-offloaded run (several
+    layer groups per step) with a throttled interconnect, synchronous
+    vs double-buffered ring. Sleep-dominated like the input probe, so
+    the ratio is structural and gates HARD — if the ring silently
+    degrades to inline transfers it collapses to ~1. A second,
+    unthrottled pair measures the offloaded-vs-in-core step overhead
+    (report-only: real wall time, noisy on shared runners)."""
+    import numpy
+
+    from veles_tpu import prng
+    from veles_tpu.backends import Device
+    from veles_tpu.dummy import DummyLauncher
+    from veles_tpu.models.mnist import MnistWorkflow
+    from veles_tpu.train import FusedTrainer
+    from veles_tpu.train import offload
+
+    saved = {k: os.environ.get(k) for k in
+             ("VELES_OFFLOAD_THROTTLE_MS", "VELES_OFFLOAD_GROUP_MB")}
+    os.environ["VELES_OFFLOAD_GROUP_MB"] = "0.001"
+
+    rng = numpy.random.RandomState(SEED)
+    x = rng.rand(200, 6, 6).astype(numpy.float32)
+    y = (x.reshape(200, -1).sum(1) > 18).astype(numpy.int32)
+
+    def run(offloaded, depth, workers, throttle_ms):
+        os.environ["VELES_OFFLOAD_THROTTLE_MS"] = str(throttle_ms)
+        prng.get().seed(SEED)
+        prng.get("loader").seed(SEED + 1)
+        wf = MnistWorkflow(
+            DummyLauncher(),
+            provider=lambda: (x[:160], y[:160], x[160:], y[160:]),
+            layers=(16, 12), minibatch_size=20, max_epochs=1)
+        wf.initialize(device=Device(backend=None))
+        trainer = FusedTrainer(wf, offload=offloaded,
+                               offload_depth=depth,
+                               offload_workers=workers)
+        assert trainer.offloaded == offloaded
+        t0 = time.perf_counter()
+        trainer.train()
+        return trainer.offload_wait_s * 1e3, time.perf_counter() - t0
+
+    try:
+        sync_ms, _ = run(True, 0, 1, 40)
+        double_ms, _ = run(True, 6, 2, 40)
+        _, incore_s = run(False, 0, 1, 0)
+        _, off_s = run(True, 6, 2, 0)
+    finally:
+        offload.shutdown_all()
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+    return {"offload_overlap_ratio": sync_ms / max(double_ms, 1e-9),
+            "offload_step_overhead_ratio": off_s / max(incore_s, 1e-9)}
+
+
 def _federation_probe(n_series=100, beats=50, rounds=3):
     """ISSUE 9 overhead guard (report-only): heartbeat round-trip with
     vs. without the federation snapshot piggyback, over a real
@@ -590,6 +648,7 @@ def capture():
     if rss:
         metrics["host_rss_gb"] = rss / 2.0 ** 30
     metrics.update(_input_pipeline_probe())
+    metrics.update(_offload_probe())
     metrics.update(_gspmd_probe())
     metrics.update(_federation_probe())
     metrics.update(_recovery_probe())

@@ -23,7 +23,11 @@ segments, harvests ``Compiled.cost_analysis()`` through the cost book,
 and prints the per-op attribution table (analytic FLOPs/bytes,
 arithmetic intensity, measured ms, achieved TFLOP/s, roofline bound
 verdict), the step MFU, the startup-phase breakdown and a memory
-sample — the same numbers ``/profile.json`` serves live. On non-TPU
+sample — the same numbers ``/profile.json`` serves live. Under
+``VELES_OFFLOAD=1`` the trainer runs out-of-core and the table grows
+one ``offload:h2d/g<k>`` / ``offload:d2h/g<k>`` roofline row per
+streamed layer group (bytes moved, p50 ms, achieved GB/s), followed
+by a transfer-vs-compute verdict naming a transfer-bound step. On non-TPU
 hosts set ``VELES_PEAK_TFLOPS`` / ``VELES_HBM_GBPS`` to get MFU and
 verdicts; without peaks the table still carries the absolute numbers.
 
@@ -270,6 +274,26 @@ def attribution_main():
             _fmt(row.get("achieved_gbps"), "%.1f"),
             row.get("bound", "-")))
     print()
+    off_rows = [r for r in report["ops"]
+                if r["op"].startswith("offload:")]
+    if off_rows:
+        # out-of-core run (VELES_OFFLOAD=1): the CostBook carries one
+        # roofline row per streamed group direction; name the verdict
+        # the roofline table only implies — is the step transfer-bound?
+        seg = next((r for r in report["ops"]
+                    if r["op"] == "train_segment"), {})
+        xfer_ms = sum((r.get("p50_ms") or 0.0) * (r.get("calls") or 0)
+                      for r in off_rows) / max(SEGMENTS, 1)
+        moved_mb = sum((r.get("bytes") or 0) * (r.get("calls") or 0)
+                       for r in off_rows) / max(SEGMENTS, 1) / 1e6
+        seg_ms = seg.get("p50_ms") or 0.0
+        verdict = ("TRANSFER-bound" if seg_ms and xfer_ms > 0.5 * seg_ms
+                   else "compute-bound")
+        print("offload traffic: %.1f MB moved / %.1f ms transfer time "
+              "per segment (%d h2d/d2h rows) vs segment p50 %.1f ms "
+              "-> %s step" % (moved_mb, xfer_ms, len(off_rows),
+                              seg_ms, verdict))
+        print()
     mfu = report.get("step_mfu")
     print("step MFU: " + ("%.1f%%" % (mfu * 100.0) if mfu
                           else "n/a (no device peak known)"))

@@ -92,6 +92,8 @@ def _stop_telemetry_threads():
     # prefetch pipelines first: their workers hold jax arrays, and a
     # worker mid-device_put through interpreter teardown is the same
     # "terminate called without an active exception" window
+    from veles_tpu.train import offload
+    offload.shutdown_all()
     from veles_tpu.loader import prefetch
     prefetch.shutdown_all()
     from veles_tpu.telemetry import alerts, flight, profiler

@@ -1,0 +1,298 @@
+"""Out-of-core MODEL state (ISSUE 17): host-offloaded param/optimizer
+layer groups streamed through the double-buffered staging ring, with
+the loss curve pinned bit-identical to the in-core run."""
+
+import threading
+
+import numpy
+import pytest
+
+from veles_tpu import prng, snapshotter
+from veles_tpu.backends import Device
+from veles_tpu.dummy import DummyLauncher
+from veles_tpu.models.mnist import MnistWorkflow
+from veles_tpu.train import FusedTrainer
+from veles_tpu.train import offload
+from veles_tpu.train.runner import FusedRunner
+
+from test_mnist_e2e import synthetic_digits
+
+
+def _offload_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith(("veles-prefetch-offload",
+                                  "veles-offload"))]
+
+
+# -- planning ----------------------------------------------------------------
+
+
+def test_plan_build_greedy_groups():
+    plan = offload.OffloadPlan.build([10, 10, 10], budget=25)
+    assert plan.groups == [(0, 2), (2, 3)]
+    assert plan.group_bytes == [20, 10]
+    assert plan.total_bytes == 30
+    # a single layer larger than the budget becomes its own group
+    plan = offload.OffloadPlan.build([30, 4, 4], budget=9)
+    assert plan.groups == [(0, 1), (1, 3)]
+    # everything fits one group
+    assert offload.OffloadPlan.build([1, 2], budget=100).groups == \
+        [(0, 2)]
+
+
+def test_plan_offload_knob(monkeypatch):
+    monkeypatch.delenv("VELES_OFFLOAD", raising=False)
+    monkeypatch.setenv("VELES_DEVICE_BUDGET_MB", "1")
+    assert offload.plan_offload(2e6) == "offloaded"
+    assert offload.plan_offload(0.5e6) == "resident"
+    monkeypatch.setenv("VELES_OFFLOAD", "0")
+    assert offload.plan_offload(2e6) == "resident"
+    monkeypatch.setenv("VELES_OFFLOAD", "1")
+    assert offload.plan_offload(10.0) == "offloaded"
+    monkeypatch.delenv("VELES_OFFLOAD", raising=False)
+    monkeypatch.delenv("VELES_DEVICE_BUDGET_MB", raising=False)
+    # CPU: no bytes_limit -> unknown budget -> resident (what keeps
+    # tier-1 unchanged on stock runners)
+    assert offload.plan_offload(1e15) == "resident"
+
+
+def test_group_budget_override(monkeypatch):
+    monkeypatch.setenv("VELES_OFFLOAD_GROUP_MB", "3")
+    assert offload.group_budget_bytes() == 3e6
+    monkeypatch.delenv("VELES_OFFLOAD_GROUP_MB", raising=False)
+    # device budget / (depth + 2) when the budget is known
+    monkeypatch.setenv("VELES_DEVICE_BUDGET_MB", "40")
+    assert offload.group_budget_bytes(depth=2) == 1e7
+
+
+# -- staging-ring generalization ---------------------------------------------
+
+
+def test_staging_ring_accepts_pytrees():
+    import jax
+    from veles_tpu.loader import prefetch
+    ring = prefetch.StagingRing(2, jax.device_put)
+    tree = ({"w": numpy.ones((2, 2), numpy.float32)},
+            (numpy.arange(3),))
+    placed = ring.place(tree)
+    assert isinstance(placed[0]["w"], jax.Array)
+    numpy.testing.assert_array_equal(
+        numpy.asarray(placed[0]["w"]), tree[0]["w"])
+    numpy.testing.assert_array_equal(
+        numpy.asarray(placed[1][0]), tree[1][0])
+    ring.clear()
+
+
+# -- loss-curve parity -------------------------------------------------------
+
+
+def build_wf(seed=42, n_train=720, n_valid=120, mb=60, max_epochs=3):
+    prng.get().seed(seed)
+    prng.get("loader").seed(seed + 1)
+    wf = MnistWorkflow(DummyLauncher(),
+                       provider=synthetic_digits(n_train=n_train,
+                                                 n_valid=n_valid),
+                       layers=(32, 24), minibatch_size=mb,
+                       learning_rate=0.08, max_epochs=max_epochs)
+    wf.initialize(device=Device(backend="cpu"))
+    return wf
+
+
+def _curve(history):
+    return [e["validation"]["normalized"] for e in history]
+
+
+def test_offloaded_matches_incore_bitexact(monkeypatch):
+    """Grouped chained-vjp walk over host masters == fused in-core
+    scan, over multiple epochs (epoch wrap + reshuffle happen while
+    the ring streams)."""
+    incore = _curve(FusedTrainer(build_wf()).train())
+    monkeypatch.setenv("VELES_OFFLOAD", "1")
+    monkeypatch.setenv("VELES_OFFLOAD_GROUP_MB", "0.001")
+    trainer = FusedTrainer(build_wf())
+    assert trainer.offloaded
+    assert trainer._offload_engine.plan.n_groups >= 2
+    offloaded = _curve(trainer.train())
+    numpy.testing.assert_array_equal(incore, offloaded)
+    assert trainer.offload_wait_s > 0
+    assert not _offload_threads()
+
+
+def test_offload_depth_zero_synchronous(monkeypatch):
+    """VELES_OFFLOAD_DEPTH=0: every transfer inline on the step thread
+    — the bench's sync leg — still bit-identical, zero ring threads."""
+    incore = _curve(FusedTrainer(build_wf(max_epochs=2)).train())
+    monkeypatch.setenv("VELES_OFFLOAD", "1")
+    monkeypatch.setenv("VELES_OFFLOAD_GROUP_MB", "0.001")
+    trainer = FusedTrainer(build_wf(max_epochs=2), offload_depth=0)
+    assert trainer.offloaded and trainer._offload_engine.depth == 0
+    sync = _curve(trainer.train())
+    numpy.testing.assert_array_equal(incore, sync)
+    assert not _offload_threads()
+
+
+def test_offload_disabled_bypass(monkeypatch):
+    """VELES_OFFLOAD=0 forces in-core residency whatever the budget."""
+    monkeypatch.setenv("VELES_OFFLOAD", "0")
+    monkeypatch.setenv("VELES_DEVICE_BUDGET_MB", "0.000001")
+    trainer = FusedTrainer(build_wf(max_epochs=1))
+    assert not trainer.offloaded
+    assert trainer._offload_engine is None
+    trainer.shutdown()
+
+
+def test_offload_grad_norms(monkeypatch):
+    """Per-group gsq partials sum to a finite global norm per batch
+    (observational — summation order differs from the fused reduction,
+    so values are close, not pinned)."""
+    t0 = FusedTrainer(build_wf(max_epochs=1), grad_norms=True)
+    t0.train()
+    ref = numpy.asarray(t0.last_grad_norms)
+    monkeypatch.setenv("VELES_OFFLOAD", "1")
+    monkeypatch.setenv("VELES_OFFLOAD_GROUP_MB", "0.001")
+    t1 = FusedTrainer(build_wf(max_epochs=1), grad_norms=True)
+    assert t1.offloaded
+    t1.train()
+    got = numpy.asarray(t1.last_grad_norms)
+    assert got.shape == ref.shape
+    numpy.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_offload_streamed_dataset_wins(monkeypatch):
+    """The two rings don't compose: a streamed dataset keeps the
+    params in-core (warned, not crashed)."""
+    monkeypatch.setenv("VELES_OFFLOAD", "1")
+    monkeypatch.setenv("VELES_SHARD_MB", "0.1")
+    trainer = FusedTrainer(build_wf(max_epochs=1), stream=True)
+    assert trainer.streaming
+    assert not trainer.offloaded
+    trainer.shutdown()
+
+
+# -- checkpoints across residency modes --------------------------------------
+
+
+def _continue_restored(tmp_path):
+    wf, _ = snapshotter.restore_latest(str(tmp_path))
+    wf.initialize(device=Device(backend="cpu"))
+    resume_epoch = wf.decision.prepare_resume()
+    assert resume_epoch is not None
+    wf.loader.reset_to_epoch_start(resume_epoch)
+    return wf
+
+
+def test_offloaded_checkpoint_restores_into_either_mode(
+        tmp_path, monkeypatch):
+    """A sharded checkpoint cut from an OFFLOADED run (host masters)
+    restores into the in-core AND the offloaded mode, both continuing
+    bit-identically to the uninterrupted in-core run."""
+    full = _curve(FusedTrainer(build_wf()).train())
+
+    monkeypatch.setenv("VELES_OFFLOAD", "1")
+    monkeypatch.setenv("VELES_OFFLOAD_GROUP_MB", "0.001")
+    trainer = FusedTrainer(build_wf())
+    assert trainer.offloaded
+    saved = []
+
+    def cut(tr, params, states):
+        if saved:
+            return
+        # host-master pytrees: the save path must shard-encode numpy
+        assert isinstance(
+            next(iter(params[0].values())), numpy.ndarray)
+        snapshotter.save_snapshot_sharded(
+            tr.workflow, str(tmp_path),
+            tr.checkpoint_records(params, states), tag="_e0")
+        saved.append(True)
+
+    trainer.train(epoch_callback=cut)
+    assert saved
+
+    # continue IN-CORE from the offloaded-run checkpoint
+    monkeypatch.setenv("VELES_OFFLOAD", "0")
+    wf_in = _continue_restored(tmp_path)
+    t_in = FusedTrainer(wf_in)
+    assert not t_in.offloaded
+    curve_in = _curve(t_in.train())
+    numpy.testing.assert_array_equal(full, curve_in)
+
+    # continue OFFLOADED from the same checkpoint
+    monkeypatch.setenv("VELES_OFFLOAD", "1")
+    wf_off = _continue_restored(tmp_path)
+    t_off = FusedTrainer(wf_off)
+    assert t_off.offloaded
+    curve_off = _curve(t_off.train())
+    numpy.testing.assert_array_equal(full, curve_off)
+    assert not _offload_threads()
+
+
+# -- runner + telemetry ------------------------------------------------------
+
+
+def test_offloaded_runner_end_to_end(monkeypatch):
+    """FusedRunner drives an offloaded workflow: curve parity, the
+    offload metric families fill, and shutdown leaves no threads."""
+    from veles_tpu.telemetry.registry import get_registry
+    registry = get_registry()
+    for name in ("veles_offload_h2d_ms", "veles_offload_d2h_ms",
+                 "veles_offload_wait_ms",
+                 "veles_offload_compute_overlap_fraction"):
+        metric = registry.get(name)
+        if metric is not None:
+            metric.reset()
+    incore = _curve(FusedTrainer(build_wf(max_epochs=2)).train())
+    monkeypatch.setenv("VELES_OFFLOAD", "1")
+    monkeypatch.setenv("VELES_OFFLOAD_GROUP_MB", "0.001")
+    wf = build_wf(max_epochs=2)
+    runner = FusedRunner(wf, trainer=FusedTrainer(wf))
+    runner.run()
+    assert _curve(wf.decision.epoch_history) == incore
+    assert registry.get("veles_offload_h2d_ms").labels().count > 0
+    assert registry.get("veles_offload_d2h_ms").labels().count > 0
+    gauge = registry.get("veles_offload_compute_overlap_fraction")
+    phases = {labels["phase"] for labels, _ in gauge.series()}
+    assert {"train", "eval", "epoch"} <= phases
+    assert not _offload_threads()
+
+
+def test_offload_reshard_telemetry(monkeypatch):
+    """Every layer-group upload lands in the reshard histogram under
+    src="host" — the seam ISSUE 15 established for layout moves."""
+    from veles_tpu.telemetry.registry import get_registry
+    registry = get_registry()
+    hist = registry.get("veles_reshard_ms")
+    if hist is not None:
+        hist.reset()
+    monkeypatch.setenv("VELES_OFFLOAD", "1")
+    monkeypatch.setenv("VELES_OFFLOAD_GROUP_MB", "0.001")
+    FusedTrainer(build_wf(max_epochs=1)).train()
+    hist = registry.get("veles_reshard_ms")
+    series = {tuple(sorted(labels.items())): child
+              for labels, child in hist.series()}
+    key = (("dst", "committed"), ("src", "host"))
+    assert key in series and series[key].count > 0
+
+
+def test_throttled_overlap_reduces_wait(monkeypatch):
+    """The measured overlap win: with deliberately slow transfers the
+    double-buffered ring must cut the step thread's transfer wait well
+    below the synchronous leg (generous margin — CI runners jitter)."""
+    monkeypatch.setenv("VELES_OFFLOAD", "1")
+    monkeypatch.setenv("VELES_OFFLOAD_GROUP_MB", "0.001")
+    monkeypatch.setenv("VELES_OFFLOAD_THROTTLE_MS", "10")
+
+    def run(depth, workers):
+        trainer = FusedTrainer(build_wf(max_epochs=1),
+                               offload_depth=depth,
+                               offload_workers=workers)
+        assert trainer.offloaded
+        trainer.train()
+        return trainer.offload_wait_s
+
+    sync_s = run(0, 1)
+    # deep staging (a whole batch walk ahead) like the bench's double
+    # leg — depth 2 leaves little lookahead over the 2G-1 per-batch
+    # transfer tasks, and a loaded CI runner erodes the thin margin
+    double_s = run(6, 2)
+    assert double_s < sync_s * 0.75, (sync_s, double_s)
+    assert not _offload_threads()

@@ -1,0 +1,90 @@
+"""Direct unit coverage of the reshard seam (ISSUE 15/17): the host
+layout — ``gather_to_host`` round-trips, host->NamedSharding
+placement, the measured ``host_placer`` H2D leg, and bounded
+``{src,dst}`` label cardinality."""
+
+import jax
+import numpy
+
+from veles_tpu.parallel import build_mesh, named_sharding
+from veles_tpu.parallel import reshard
+
+
+def _series(registry_name="veles_reshard_ms"):
+    from veles_tpu.telemetry.registry import get_registry
+    hist = get_registry().get(registry_name)
+    if hist is None:
+        return {}
+    return {tuple(sorted(labels.items())): child
+            for labels, child in hist.series()}
+
+
+def test_gather_to_host_round_trip():
+    host = numpy.arange(48, dtype=numpy.float32).reshape(8, 6)
+    mesh = build_mesh({"data": 8})
+    sharded = reshard.reshard(host, named_sharding(mesh, "data"))
+    assert isinstance(sharded, jax.Array)
+    back = reshard.gather_to_host(sharded)
+    assert isinstance(back, numpy.ndarray)
+    assert back.dtype == host.dtype
+    numpy.testing.assert_array_equal(back, host)
+
+
+def test_host_to_named_sharding_placement():
+    host = numpy.arange(32, dtype=numpy.int32).reshape(8, 4)
+    mesh = build_mesh({"data": 8})
+    out = reshard.reshard(host, named_sharding(mesh, "data"))
+    assert out.sharding.spec == jax.sharding.PartitionSpec("data")
+    # each device holds exactly its 1/8 slice
+    for shard in out.addressable_shards:
+        numpy.testing.assert_array_equal(
+            numpy.asarray(shard.data), host[shard.index])
+    numpy.testing.assert_array_equal(numpy.asarray(out), host)
+
+
+def test_host_placer_records_host_to_committed():
+    from veles_tpu.telemetry.registry import get_registry
+    hist = get_registry().get("veles_reshard_ms")
+    if hist is not None:
+        hist.reset()
+    place = reshard.host_placer()
+    host = numpy.ones((4, 4), numpy.float32)
+    out = place(host)
+    assert isinstance(out, jax.Array)
+    numpy.testing.assert_array_equal(numpy.asarray(out), host)
+    series = _series()
+    key = (("dst", "committed"), ("src", "host"))
+    assert key in series and series[key].count == 1
+
+
+def test_host_placer_uses_device_put(monkeypatch):
+    calls = []
+
+    class FakeDevice(object):
+        is_jax = True
+
+        def put(self, value):
+            calls.append(value.shape)
+            return jax.device_put(value)
+
+    place = reshard.host_placer(FakeDevice())
+    place(numpy.zeros((2, 3), numpy.float32))
+    assert calls == [(2, 3)]
+
+
+def test_layout_label_bounded_cardinality():
+    mesh = build_mesh({"data": 8})
+    host = numpy.zeros((8, 2), numpy.float32)
+    labels = {
+        reshard.layout_label(host),
+        reshard.layout_label(jax.device_put(host)),
+        reshard.layout_label(named_sharding(mesh, "data")),
+        reshard.layout_label(named_sharding(mesh)),
+    }
+    assert labels == {"host", "committed", "P(data)", "replicated"}
+    # label space stays layouts, never array identities: a second
+    # array in the same layout maps to the same label
+    assert reshard.layout_label(
+        numpy.ones((3,), numpy.float32)) == "host"
+    assert reshard.layout_label(
+        jax.device_put(numpy.ones(3))) == "committed"

@@ -113,7 +113,7 @@ class PrefetchPipeline(object):
     """
 
     def __init__(self, produce, n_items, depth=None, workers=None,
-                 name="input"):
+                 name="input", wait_hist=None, fill_phase="pipeline_fill"):
         self.produce = produce
         self.n_items = int(n_items)
         self.depth = default_depth() if depth is None else max(0, depth)
@@ -128,7 +128,12 @@ class PrefetchPipeline(object):
         self._next_get = 0
         self._stop = False
         self._threads = []
-        self._wait_hist = input_wait_histogram()
+        # non-input consumers (the model-offload ring, ISSUE 17) keep
+        # their waits out of the input-starvation accounting: they pass
+        # their own histogram and opt out of the pipeline_fill phase
+        self._wait_hist = (input_wait_histogram() if wait_hist is None
+                           else wait_hist)
+        self._fill_phase = fill_phase
 
     # -- worker side --------------------------------------------------------
 
@@ -205,8 +210,9 @@ class PrefetchPipeline(object):
                              pipeline=self.name)
         if self.first_wait_s is None:
             self.first_wait_s = wait
-            from veles_tpu.telemetry import profiler
-            profiler.record_phase("pipeline_fill", wait)
+            if self._fill_phase:
+                from veles_tpu.telemetry import profiler
+                profiler.record_phase(self._fill_phase, wait)
         if kind == "error":
             self.close()
             raise payload
@@ -253,12 +259,14 @@ def shutdown_all(timeout=10.0):
 class StagingRing(object):
     """Fixed ring of device staging slots for streamed shards.
 
-    ``place()`` transfers a tuple of host arrays through the next slot
-    and deletes the slot's previous occupant first, so at most
-    ``slots`` shards are ever device-resident — the flat-HBM guarantee
-    out-of-core streaming depends on. ``placer`` maps one host array
-    to its device form (plain ``device_put``, or a ``NamedSharding``
-    placement for data-parallel meshes).
+    ``place()`` transfers a PYTREE of host arrays (a loader shard's
+    ``(data, truth)`` tuple, or a model layer group's params/opt-state
+    dicts — ISSUE 17) through the next slot and deletes the slot's
+    previous occupant first, so at most ``slots`` shards are ever
+    device-resident — the flat-HBM guarantee out-of-core streaming
+    depends on. ``placer`` maps one host LEAF to its device form
+    (plain ``device_put``, a ``NamedSharding`` placement for
+    data-parallel meshes, or the measured ``reshard.host_placer``).
     """
 
     def __init__(self, slots, placer):
@@ -270,7 +278,8 @@ class StagingRing(object):
 
     @staticmethod
     def _delete(arrays):
-        for arr in arrays:
+        import jax
+        for arr in jax.tree_util.tree_leaves(arrays):
             try:
                 # PJRT defers the actual free until in-flight executions
                 # using the buffer complete, so deleting here (while the
@@ -288,7 +297,8 @@ class StagingRing(object):
             self._slots[idx] = None
         if old is not None:
             self._delete(old)
-        placed = tuple(self._placer(a) for a in host_arrays)
+        import jax
+        placed = jax.tree_util.tree_map(self._placer, host_arrays)
         with self._lock:
             if self._closed:
                 # clear() raced an in-flight place (a worker past its

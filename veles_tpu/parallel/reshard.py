@@ -123,6 +123,32 @@ def reshard_tree(tree, shardings, *, block=False):
         lambda v, s: reshard(v, s, block=block), tree, shardings)
 
 
+def host_placer(device=None):
+    """Host ndarray -> committed single-device array, measured as
+    ``veles_reshard_ms{src="host", dst="committed"}``.
+
+    The H2D leg of the out-of-core model-state ring (ISSUE 17): the
+    offload engine hands this to its :class:`StagingRing` so every
+    layer-group upload shows up in the reshard histogram alongside the
+    other layout moves, instead of hiding inside a bare
+    ``device_put``. Mirrors :func:`gather_to_host`, the D2H leg."""
+    if device is not None and getattr(device, "is_jax", False):
+        put = device.put
+    else:
+        put = jax.device_put
+
+    def place(host_array):
+        t0 = time.perf_counter()
+        out = put(host_array)
+        elapsed = time.perf_counter() - t0
+        reshard_histogram().labels(src="host", dst="committed").observe(
+            elapsed * 1e3)
+        tracing.add_complete("reshard", t0, elapsed, src="host",
+                             dst="committed")
+        return out
+    return place
+
+
 def gather_to_host(value):
     """The serve-side terminal move: any layout -> a full host ndarray
     (the all-gather decomposition, then device->host). Measured under

@@ -637,12 +637,25 @@ def save_snapshot_sharded(workflow, directory, records, *,
     name = "%s%s.%d%s" % (prefix, tag, epoch, SHARDED_SUFFIX)
     gen_dir = os.path.join(directory, name)
     os.makedirs(gen_dir, exist_ok=True)
+    import numpy as _np
     out_records = []
     for spec, value in records:
         meta, entries = shard_records(value)
         if meta is None:
             if process_index == 0:
-                out_records.append({"spec": spec, "value": value})
+                if isinstance(value, _np.ndarray):
+                    # host-master leaves (an offloaded run's params/opt
+                    # state, ISSUE 17): encode as one full-coverage
+                    # shard so restore validates them like any device
+                    # leaf — and the generation restores bit-identically
+                    # into EITHER residency mode
+                    out_records.append({
+                        "spec": spec, "shape": tuple(value.shape),
+                        "dtype": str(value.dtype),
+                        "shards": [((slice(None),) * value.ndim,
+                                    _np.asarray(value))]})
+                else:
+                    out_records.append({"spec": spec, "value": value})
             continue
         out_records.append({"spec": spec, "shape": meta["shape"],
                             "dtype": meta["dtype"], "shards": entries})

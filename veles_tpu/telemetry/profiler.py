@@ -384,7 +384,7 @@ class timed_op(object):
 
 PHASES = ("dataset_generate", "dataset_load", "autotune_load",
           "compile", "warmup", "replica_warmup", "pipeline_fill",
-          "first_step")
+          "offload_plan", "first_step")
 
 _phase_lock = threading.Lock()
 _phase_ms = {}  # phase -> cumulative ms this process

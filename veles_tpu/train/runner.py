@@ -140,6 +140,11 @@ class FusedRunner(Logger):
         # win of ISSUE 8, measured not asserted)
         from veles_tpu.loader import prefetch
         self._starvation = prefetch.starvation_gauge()
+        # out-of-core MODEL state (ISSUE 17): per-epoch compute/transfer
+        # overlap fraction of the offload staging ring, same shape of
+        # accounting as the input-side starvation gauge above
+        from veles_tpu.train import offload
+        self._offload_overlap = offload.overlap_gauge()
 
     def _timed_step(self, phase, fn, *args, **kwargs):
         """Run one sweep under a span + the step histogram, with the
@@ -427,6 +432,7 @@ class FusedRunner(Logger):
                     loader.last_minibatch <<= False
                 epoch_start = time.perf_counter()
                 epoch_wait0 = trainer.input_wait_s
+                epoch_owait0 = getattr(trainer, "offload_wait_s", 0.0)
                 testing = bool(decision.testing)
                 stats = self._timed_step("eval", self._eval_classes,
                                          params, testing)
@@ -460,6 +466,16 @@ class FusedRunner(Logger):
                     self.debug("epoch %d input wait %.0f ms "
                                "(%.1f%% starved)", epochs_done,
                                epoch_wait * 1e3, fraction * 100.0)
+                if getattr(trainer, "offloaded", False) and \
+                        epoch_elapsed > 0:
+                    owait = getattr(trainer, "offload_wait_s", 0.0) - \
+                        epoch_owait0
+                    overlap = max(0.0, 1.0 - owait / epoch_elapsed)
+                    self._offload_overlap.labels(phase="epoch").set(
+                        overlap)
+                    self.debug("epoch %d offload wait %.0f ms "
+                               "(%.1f%% overlapped)", epochs_done,
+                               owait * 1e3, overlap * 100.0)
                 epochs_done += 1
                 self._epoch_index = epochs_done
                 samples_done += sum(s["samples"] for s in stats.values())

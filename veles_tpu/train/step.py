@@ -60,6 +60,16 @@ class FusedTrainer(Logger):
     so datasets larger than HBM train out-of-core instead of OOMing.
     ``stream=None`` auto-decides (``VELES_STREAM`` /
     ``VELES_DEVICE_BUDGET_MB`` override); True/False force.
+
+    MODEL state gets the same treatment (ISSUE 17,
+    :mod:`veles_tpu.train.offload`): when the params + optimizer state
+    exceed the device budget (or ``VELES_OFFLOAD``/``offload=True``
+    force it), the master copies stay on host and the step walks layer
+    groups through a double-buffered staging ring — H2D prefetch of
+    group k+1 overlaps group k's compute, updated groups retire D2H on
+    a writeback thread. The loss curve is bit-identical to the in-core
+    run (pinned by tests/test_offload.py). Offload composes with a
+    RESIDENT dataset only; a streamed dataset wins the ring.
     """
 
     #: cost-book op namespace: parallel trainers that compile a
@@ -71,7 +81,8 @@ class FusedTrainer(Logger):
 
     def __init__(self, workflow, donate=None, stage_s2d=True,
                  grad_norms=None, stream=None, prefetch_depth=None,
-                 prefetch_workers=None):
+                 prefetch_workers=None, offload=None,
+                 offload_depth=None, offload_workers=None):
         super(FusedTrainer, self).__init__()
         self.workflow = workflow
         self.loader = workflow.loader
@@ -83,6 +94,13 @@ class FusedTrainer(Logger):
         self.stream = stream
         self.prefetch_depth = prefetch_depth
         self.prefetch_workers = prefetch_workers
+        #: model-state residency (ISSUE 17): ``None`` auto-decides
+        #: (``VELES_OFFLOAD`` / device budget), True/False force
+        self.offload = offload
+        self.offload_depth = offload_depth
+        self.offload_workers = offload_workers
+        self.offloaded = False
+        self._offload_engine = None
         #: cumulative step-thread input wait (streamed mode); the
         #: runner reads deltas of this per epoch
         self.input_wait_s = 0.0
@@ -148,16 +166,28 @@ class FusedTrainer(Logger):
         ``aux`` (train path): a list that collects units' auxiliary
         loss terms (e.g. MoE load balancing) for the grad loss;
         ``valid`` is the padded-row mask those terms must respect."""
-        for i, fwd in enumerate(self.forwards):
+        return self._forward_range(params_list, x, key, train, 0,
+                                   len(self.forwards), aux=aux,
+                                   valid=valid)
+
+    def _forward_range(self, params_list, x, key, train, lo, hi,
+                       aux=None, valid=None):
+        """Forward through layers ``[lo, hi)`` only — the group-walk
+        primitive of offloaded execution (ISSUE 17); ``_forward`` is
+        the full range. ``params_list`` holds ONLY the range's layers,
+        but dropout keys fold by the ABSOLUTE layer index, so a
+        grouped walk reproduces the fused chain bit-for-bit."""
+        for j, fwd in enumerate(self.forwards[lo:hi]):
+            i = lo + j
             if aux is not None:
                 aux_fn = getattr(fwd, "aux_loss", None)
                 if aux_fn is not None and \
                         getattr(fwd, "aux_loss_weight", 0.0):
-                    aux.append(aux_fn(params_list[i], x, valid=valid))
+                    aux.append(aux_fn(params_list[j], x, valid=valid))
             is_head = i == len(self.forwards) - 1
             if isinstance(fwd, DropoutForward):
                 if train:
-                    x = fwd.apply_with_key(params_list[i], x,
+                    x = fwd.apply_with_key(params_list[j], x,
                                            jax.random.fold_in(key, i))
             elif i == 0 and self._staged_s2d:
                 # dataset was packed to patch-channel layout at
@@ -167,11 +197,11 @@ class FusedTrainer(Logger):
                 # directly — no per-step rearrange. Numerics identical
                 # to fwd.apply on raw.
                 x = x.reshape((x.shape[0],) + self._staged_sample_shape)
-                x = fwd.apply_staged(params_list[i], x)
+                x = fwd.apply_staged(params_list[j], x)
             elif is_head:
-                x = fwd.apply_for_grad(params_list[i], x)
+                x = fwd.apply_for_grad(params_list[j], x)
             else:
-                x = fwd.apply(params_list[i], x)
+                x = fwd.apply(params_list[j], x)
         return x
 
     def _loss_and_metrics(self, out, labels_or_targets, valid):
@@ -359,6 +389,54 @@ class FusedTrainer(Logger):
             "depth %d", total_bytes / 1e6, self._batches_per_shard,
             self._batches_per_shard * batch_bytes / 1e6, depth)
 
+    # -- model residency: in-core OR host-offloaded (ISSUE 17) --------------
+
+    def _setup_model_residency(self):
+        """The model-state analogue of :meth:`_setup_data_residency`:
+        params/opt-state stay device-resident across the segment scan
+        when they fit the budget, or offload to host masters walked
+        group-by-group through :mod:`veles_tpu.train.offload`'s
+        double-buffered staging ring when they don't (``offload=`` /
+        ``VELES_OFFLOAD`` force)."""
+        from veles_tpu.train import offload
+        device = getattr(self.loader.original_data, "device", None)
+        layer_bytes = offload.model_layer_bytes(self.forwards,
+                                                self.solvers)
+        decision = offload.plan_offload(sum(layer_bytes), device=device,
+                                        force=self.offload)
+        if decision != "offloaded":
+            return
+        if self.streaming:
+            self.warning(
+                "offloaded model state requires a resident dataset — "
+                "the streamed input pipeline already owns the staging "
+                "budget; keeping params in-core")
+            return
+        depth = (offload.offload_depth() if self.offload_depth is None
+                 else max(0, self.offload_depth))
+        with profiler.phase("offload_plan"):
+            with tracing.span("offload:plan"):
+                plan = offload.OffloadPlan.build(
+                    layer_bytes,
+                    offload.group_budget_bytes(device, depth))
+                self._offload_engine = offload.OffloadEngine(
+                    self, plan, depth=depth,
+                    workers=self.offload_workers)
+        self.offloaded = True
+        self.info(
+            "model state offloads out-of-core: %.1f MB in %d layer "
+            "groups (%s), staging depth %d",
+            plan.total_bytes / 1e6, plan.n_groups,
+            "/".join("%d-%d" % g for g in plan.groups), depth)
+
+    @property
+    def offload_wait_s(self):
+        """Cumulative step-thread transfer wait of offloaded segments
+        (the runner and benches read deltas — mirrors
+        :attr:`input_wait_s`)."""
+        engine = self._offload_engine
+        return engine.wait_s if engine is not None else 0.0
+
     def _shard_bounds(self, n_rows):
         """[(row0, row1)] index-matrix row ranges, one per shard."""
         rows = max(1, min(self._batches_per_shard, n_rows))
@@ -474,6 +552,9 @@ class FusedTrainer(Logger):
         ring = getattr(self, "_staging_ring", None)
         if ring is not None:
             ring.clear()
+        engine = self._offload_engine
+        if engine is not None:
+            engine.close()
 
     @staticmethod
     def _gather(data_args, idx):
@@ -516,6 +597,10 @@ class FusedTrainer(Logger):
         #: under the same flag (evaluator.py:153-154)
         self.wants_confusion = self.loss_kind == "softmax" and \
             bool(getattr(self.evaluator, "compute_confusion", False))
+
+        # model residency rides AFTER data residency: offload needs to
+        # know whether the dataset streams (the two rings don't compose)
+        self._setup_model_residency()
 
         gather = self._gather
 
@@ -575,6 +660,13 @@ class FusedTrainer(Logger):
         jit_train = self._compile_train(train_segment)
 
         def _train_segment_call(params_list, opt_states, idx_matrix, keys):
+            if self.offloaded:
+                params_list, opt_states, losses, metrics, norms = \
+                    self._offload_engine.train_segment(
+                        params_list, opt_states, idx_matrix, keys)
+                if track_norms:
+                    self.last_grad_norms = norms
+                return params_list, opt_states, losses, metrics
             if self.streaming:
                 return self._train_segment_streamed(
                     jit_train, params_list, opt_states, idx_matrix,
@@ -624,6 +716,9 @@ class FusedTrainer(Logger):
         jit_eval = self._compile_eval(eval_segment_pure)
 
         def _eval_segment_call(params_list, idx_matrix):
+            if self.offloaded:
+                return self._offload_engine.eval_segment(params_list,
+                                                         idx_matrix)
             if self.streaming:
                 return self._eval_segment_streamed(
                     jit_eval, params_list, idx_matrix)
@@ -695,6 +790,9 @@ class FusedTrainer(Logger):
                 _, confs = jax.lax.scan(body, None, idx_matrix)
                 return jnp.sum(confs, axis=0)
             fn = self._conf_fn = jax.jit(conf_pure)
+        if self.offloaded:
+            return self._offload_engine.confusion_segment(
+                params_list, numpy.asarray(idx_matrix))
         if self.streaming:
             def run_shard(data_args, local_idx, row0, row1):
                 return fn(data_args, params_list, local_idx)
@@ -729,7 +827,8 @@ class FusedTrainer(Logger):
         # streamed mode slices the index matrix on the HOST per shard;
         # committing it to the device first would be a wasted upload
         out = self._eval_segment(
-            params, idx if self.streaming else jnp.asarray(idx))
+            params,
+            idx if (self.streaming or self.offloaded) else jnp.asarray(idx))
         return out[0], out[1], out[2] if len(out) == 3 else None
 
     def train_class(self, params, states, skip=0):
@@ -745,7 +844,8 @@ class FusedTrainer(Logger):
         keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
             jnp.arange(first, first + idx.shape[0]))
         return self._train_segment(
-            params, states, idx if self.streaming else jnp.asarray(idx),
+            params, states,
+            idx if (self.streaming or self.offloaded) else jnp.asarray(idx),
             keys)
 
     # -- compilation hooks (overridden by parallel trainers) ---------------
@@ -761,7 +861,13 @@ class FusedTrainer(Logger):
     # -- parameter plumbing ------------------------------------------------
 
     def pull_params(self):
-        """Unit Arrays -> device pytrees (one-time HBM residency)."""
+        """Unit Arrays -> device pytrees (one-time HBM residency).
+
+        In offloaded mode the returned pytrees are HOST numpy masters
+        instead (the pinned out-of-core copy); the staging ring uploads
+        layer groups from them per step."""
+        if self.offloaded:
+            return self._pull_params_host()
         params = tuple(fwd.param_values() for fwd in self.forwards)
         states = []
         for i, fwd in enumerate(self.forwards):
@@ -774,6 +880,38 @@ class FusedTrainer(Logger):
             else:
                 states.append({})
         return params, tuple(states)
+
+    def _pull_params_host(self):
+        """Unit Arrays -> HOST numpy masters (out-of-core residency).
+
+        Params stay off the device entirely — ``map_read`` copies give
+        the engine mutable masters and ``release_devmem`` drops any
+        stale device mirror so the ring owns all HBM traffic. Restored
+        opt states (which a snapshot may hand back as jax arrays) are
+        normalized to numpy so a later upload sees uniform leaves."""
+        t0 = time.perf_counter()
+        params = []
+        for fwd in self.forwards:
+            layer = {}
+            for k, arr in fwd.param_arrays().items():
+                layer[k] = numpy.array(arr.map_read())
+                arr.release_devmem()
+            params.append(layer)
+        states = []
+        for i, fwd in enumerate(self.forwards):
+            gd = self.gd_for.get(id(fwd))
+            if gd is not None and params[i]:
+                if gd.opt_state is None:
+                    gd.opt_state = get_solver(gd.solver_name).init(
+                        params[i])
+                gd.opt_state = jax.tree_util.tree_map(
+                    numpy.asarray, gd.opt_state)
+                states.append(gd.opt_state)
+            else:
+                states.append({})
+        tracing.add_complete("offload:pin", t0,
+                             time.perf_counter() - t0)
+        return tuple(params), tuple(states)
 
     def checkpoint_records(self, params, states):
         """``[(spec, leaf)]`` for a sharded checkpoint of the live
@@ -804,10 +942,17 @@ class FusedTrainer(Logger):
         return records
 
     def push_params(self, params, states):
-        """Device pytrees -> unit Arrays (after training)."""
+        """Device pytrees -> unit Arrays (after training).
+
+        Offloaded runs hand back HOST masters: those go through
+        ``Array.reset`` (replacing the host buffer, no device mirror)
+        instead of ``assign_devmem``."""
         for fwd, p, s in zip(self.forwards, params, states):
             for k, arr in fwd.param_arrays().items():
-                arr.assign_devmem(p[k])
+                if self.offloaded:
+                    arr.reset(numpy.array(p[k]))
+                else:
+                    arr.assign_devmem(p[k])
             gd = self.gd_for.get(id(fwd))
             if gd is not None:
                 gd.opt_state = s

@@ -89,6 +89,23 @@ function renderPerf(p) {
         fmtGB(m.limit_bytes || 0) + "</td></tr>";
     html += "</tbody></table>";
   }
+  // per-op roofline rows from the cost book — offload:h2d/g* and
+  // offload:d2h/g* rows surface out-of-core transfer traffic here
+  const ops = (p.ops || []).filter(o => o.p50_ms != null)
+    .sort((a, b) => (b.p50_ms || 0) - (a.p50_ms || 0)).slice(0, 12);
+  if (ops.length) {
+    html += "<table style='margin-top:0.5em'><thead><tr><th>op</th>" +
+      "<th>p50 ms</th><th>MB</th><th>GB/s</th><th>bound</th>" +
+      "</tr></thead><tbody>";
+    for (const o of ops)
+      html += "<tr><td>" + o.op + "</td><td>" +
+        (o.p50_ms || 0).toFixed(2) + "</td><td>" +
+        (o.bytes != null ? (o.bytes / 1e6).toFixed(2) : "") +
+        "</td><td>" +
+        (o.achieved_gbps != null ? o.achieved_gbps.toFixed(1) : "") +
+        "</td><td>" + (o.bound || "") + "</td></tr>";
+    html += "</tbody></table>";
+  }
   const phases = Object.entries(p.phases_ms || {});
   if (phases.length) {
     // startup-phase bar: one stacked strip, widths proportional
