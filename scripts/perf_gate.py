@@ -551,6 +551,40 @@ def _serving_cache_probe(requests=200, hot=8, delay_s=0.004):
     return {"serving_cache_hit_speedup": t_off / max(t_on, 1e-9)}
 
 
+def _sched_probe():
+    """ISSUE 18 gate: the gang-scheduler contention bench in quick
+    shape — a prod job preempts a preemptible research gang on a
+    pool of one slot (checkpoint + SIGKILL + resume). The resumed
+    job's loss curve vs the uninterrupted baseline is
+    ``sched_loss_parity`` (HARD at exactly 1.0 — the determinism
+    chain from ISSUE 12/13 checkpointing rests on it); the measured
+    displacement time is ``sched_preempt_resume_s`` (report-only:
+    sleep-paced but still wall-clock on a shared runner). Runs as a
+    subprocess because the bench spawns its own worker gangs."""
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json",
+                                     delete=False) as f:
+        path = f.name
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(HERE, "scripts", "sched_bench.py"),
+             "--quick", "--json", path],
+            capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError("sched probe failed:\n%s"
+                               % out.stderr[-3000:])
+        with open(path) as f:
+            summary = json.load(f)
+    finally:
+        os.unlink(path)
+    return {"sched_preempt_resume_s":
+            float(summary["sched_preempt_resume_s"]),
+            "sched_loss_parity": float(summary["sched_loss_parity"])}
+
+
 def _serving_elastic_probe(delay_s=0.01, backlog=120):
     """ISSUE 14 autoscale guard (report-only): a real replica pool on
     a tiny jitted model, flooded so the queue breaches; measured are
@@ -655,6 +689,7 @@ def capture():
     metrics.update(_spmd_recovery_probe())
     metrics.update(_serving_cache_probe())
     metrics.update(_serving_elastic_probe())
+    metrics.update(_sched_probe())
     return {"schema": "veles-perf-snapshot/1",
             "probe": {"samples": SAMPLES, "batch": BATCH,
                       "epochs": EPOCHS, "seed": SEED},

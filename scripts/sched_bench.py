@@ -1,0 +1,207 @@
+#!/usr/bin/env python3
+"""Gang-scheduler contention bench (ISSUE 18): two tenants fight for
+a pool of ONE device slot and the preempted tenant must lose NOTHING.
+
+Leg ``baseline`` runs the elastic worker-demo uninterrupted and keeps
+its loss curve. Leg ``contended`` starts a real in-process
+``Scheduler`` over a single slot, submits the SAME demo as a
+preemptible ``research`` job, waits for its generation-initial
+checkpoint, then submits a short non-preemptible ``prod`` job — which
+forces a genuine checkpoint + SIGKILL + resume cycle on the research
+gang. Measured, not guessed:
+
+* ``sched_preempt_resume_s`` — wall time the research job spent
+  displaced (PREEMPTED -> RUNNING), the perf gate's report-only cost
+  probe;
+* ``sched_loss_parity`` — 1.0 iff the preempted job's final loss
+  curve is BIT-IDENTICAL to the uninterrupted baseline (the ISSUE 18
+  acceptance property, a HARD perf-gate metric at exactly 1.0).
+
+Scheduler state changes stream as ``EVENT`` markers on stderr in the
+elastic supervisor's announce format, so a log reader can line this
+bench up with `bench_distributed.py --chaos` output.
+
+Prints one JSON line per leg and a ``summary`` line the perf gate and
+`bench_all.py` consume.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/sched_bench.py [--epochs 4]
+        [--epoch-sleep 0.4] [--quick] [--json OUT]
+"""
+
+import argparse
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+logging.disable(logging.WARNING)
+
+T0 = time.time()
+
+
+def announce(name, **fields):
+    print("EVENT %s t=%.6f %s"
+          % (name, time.time() - T0,
+             " ".join("%s=%s" % kv for kv in sorted(fields.items()))),
+          file=sys.stderr, flush=True)
+
+
+def worker_env():
+    # the demo workers must see ONE CPU device in every leg so the
+    # curves are comparable bit-for-bit
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = os.pathsep.join(
+        [HERE] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if p])
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    return env
+
+
+def demo_argv(out, epochs, epoch_sleep=0.0):
+    argv = [sys.executable, "-m", "veles_tpu.parallel.elastic",
+            "worker-demo", "--out", out, "--epochs", str(epochs)]
+    if epoch_sleep:
+        argv += ["--epoch-sleep", str(epoch_sleep)]
+    return argv
+
+
+def wait_for_manifest(snaps, timeout_s=240.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        for dirpath, _, files in os.walk(snaps):
+            if "MANIFEST.json" in files:
+                return dirpath
+        time.sleep(0.1)
+    raise SystemExit("no checkpoint manifest appeared in %s" % snaps)
+
+
+def run_baseline(out, epochs, epoch_sleep, env):
+    announce("sched_baseline_start", epochs=epochs)
+    t0 = time.time()
+    proc = subprocess.run(demo_argv(out, epochs, epoch_sleep), env=env,
+                          capture_output=True, timeout=600)
+    if proc.returncode != 0:
+        raise SystemExit("baseline demo failed:\n%s"
+                         % proc.stderr.decode(errors="replace")[-3000:])
+    row = {"leg": "baseline", "epochs": epochs,
+           "wall_s": round(time.time() - t0, 2)}
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def run_contended(workdir, epochs, epoch_sleep, env):
+    from veles_tpu.sched import DONE, JobSpec, Scheduler
+
+    snaps = os.path.join(workdir, "snaps")
+    research_out = os.path.join(workdir, "research.json")
+    prod_out = os.path.join(workdir, "prod.json")
+    log_dir = os.path.join(workdir, "logs")
+
+    t0 = time.time()
+    sched = Scheduler(1, tick_s=0.05, min_run_s=0.5,
+                      log_dir=log_dir).start()
+    try:
+        research = sched.submit(JobSpec(
+            name="research-train",
+            argv=demo_argv(research_out, epochs, epoch_sleep),
+            tenant="research", snapshot_dir=snaps, env=env))
+        announce("sched_submit", job=research.id, tenant="research",
+                 preemptible=True)
+        # the preemption must be a genuine checkpoint + restore, not
+        # a fresh rebuild: wait for the generation-initial manifest
+        wait_for_manifest(snaps)
+        announce("sched_checkpoint", job=research.id)
+        prod = sched.submit(JobSpec(
+            name="prod-train", argv=demo_argv(prod_out, 1),
+            tenant="prod", env=env))
+        announce("sched_submit", job=prod.id, tenant="prod",
+                 preemptible=False)
+        states = sched.wait([research.id, prod.id], timeout_s=600)
+    finally:
+        sched.stop(kill=True)
+    wall = time.time() - t0
+
+    if states != {research.id: DONE, prod.id: DONE}:
+        tails = []
+        if os.path.isdir(log_dir):
+            for name in sorted(os.listdir(log_dir)):
+                with open(os.path.join(log_dir, name), "rb") as f:
+                    tails.append("%s:\n%s" % (
+                        name,
+                        f.read().decode(errors="replace")[-2000:]))
+        raise SystemExit("contended leg did not converge: %r\n%s"
+                         % (states, "\n".join(tails)))
+    announce("sched_done", preemptions=research.preemptions,
+             resume_s="%.3f" % (research.preempt_resume_s or 0.0))
+    row = {"leg": "contended", "epochs": epochs,
+           "wall_s": round(wall, 2),
+           "preemptions": research.preemptions,
+           "prod_preemptions": prod.preemptions,
+           "preempt_resume_s": round(research.preempt_resume_s or 0.0,
+                                     3),
+           "research_out": research_out}
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--epochs", type=int, default=4,
+                        help="research-job epochs (baseline matches)")
+    parser.add_argument("--epoch-sleep", type=float, default=0.4,
+                        help="injected per-epoch sleep — the window "
+                             "the prod job preempts into (no RNG "
+                             "impact, curves stay comparable)")
+    parser.add_argument("--quick", action="store_true",
+                        help="CI smoke shape: 3 epochs")
+    parser.add_argument("--json", metavar="OUT",
+                        help="also write the summary JSON here")
+    args = parser.parse_args()
+    if args.quick:
+        args.epochs = min(args.epochs, 3)
+
+    env = worker_env()
+    with tempfile.TemporaryDirectory(prefix="sched-bench-") as workdir:
+        base_out = os.path.join(workdir, "baseline.json")
+        run_baseline(base_out, args.epochs, args.epoch_sleep, env)
+        contended = run_contended(workdir, args.epochs,
+                                  args.epoch_sleep, env)
+        with open(base_out) as f:
+            base_curve = json.load(f)
+        with open(contended["research_out"]) as f:
+            research_curve = json.load(f)
+
+    parity = 1.0 if research_curve == base_curve else 0.0
+    summary = {
+        "leg": "summary", "epochs": args.epochs,
+        "preemptions": contended["preemptions"],
+        "sched_preempt_resume_s": contended["preempt_resume_s"],
+        "sched_loss_parity": parity,
+    }
+    print(json.dumps(summary), flush=True)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+            f.write("\n")
+    if contended["preemptions"] < 1:
+        raise SystemExit("prod job never preempted the research gang "
+                         "— the contention scenario did not happen")
+    if parity != 1.0:
+        raise SystemExit(
+            "preemption changed the math: the resumed curve differs "
+            "from the uninterrupted baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

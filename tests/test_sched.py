@@ -1,0 +1,604 @@
+"""Gang scheduler tests (ISSUE 18).
+
+Three tiers:
+
+* units — the job FSM (legal/illegal moves, metric counting), the
+  contiguous best-fit :class:`DevicePool`, and JobSpec validation /
+  argv parity with the serial genetics evaluator;
+* scheduler behavior — manual ``tick()`` driving with stub commands:
+  placement, fair-share waiting, preemption (victim choice, thrash
+  guard, never-same-tenant), failure reaping + flight record, the
+  control endpoint and the ``sched`` CLI clients;
+* the acceptance e2es — two tenants contending for a pool of ONE
+  slot, where the preempted job's final loss curve EXACTLY equals its
+  uninterrupted run (checkpoint + shrink + reshard-on-restore), and a
+  genetics run evaluated through the scheduler reporting the same
+  best fitness, bit-exact, as the serial path under fixed seeds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.config import root
+from veles_tpu.fairshare import DEFAULT_QOS
+from veles_tpu.genetics import GeneticsOptimizer, Tune
+from veles_tpu.sched import (DONE, FAILED, PENDING, PREEMPTED, RUNNING,
+                             DevicePool, Job, JobSpec, Scheduler,
+                             SchedulerControl,
+                             ScheduledEnsembleTrainManager,
+                             ScheduledGeneticsOptimizer)
+from veles_tpu.sched.job import InvalidTransition
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: stub gang members for scheduler-behavior tests (no JAX import)
+SLEEP = [sys.executable, "-c", "import time; time.sleep(30)"]
+QUICK = [sys.executable, "-c", "pass"]
+CRASH = [sys.executable, "-c", "import sys; sys.exit(3)"]
+
+
+def _subprocess_env(extra=None):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env.update(extra or {})
+    return env
+
+
+def _tick_until(scheduler, predicate, timeout_s=30.0, tick_s=0.05):
+    """Drive a non-started scheduler until ``predicate()`` holds."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        scheduler.tick()
+        if predicate():
+            return
+        time.sleep(tick_s)
+    raise AssertionError("condition not reached in %.0fs" % timeout_s)
+
+
+# -- the job FSM -------------------------------------------------------------
+
+
+def test_fsm_full_preempt_resume_path():
+    job = Job(JobSpec(argv=QUICK, tenant="t0"))
+    assert job.state == PENDING and job.runnable and not job.terminal
+    job.transition(RUNNING)
+    assert job.started_t is not None
+    job.transition(PREEMPTED)
+    assert job.preemptions == 1 and job.runnable
+    job.transition(RUNNING)
+    # preempt->resume latency is measured on the resume edge
+    assert job.preempt_resume_s is not None
+    assert job.preempt_resume_s >= 0.0
+    job.transition(DONE)
+    assert job.terminal and job.finished_t is not None
+    assert [s for _, s in job.history] == [
+        PENDING, RUNNING, PREEMPTED, RUNNING, DONE]
+
+
+def test_fsm_rejects_illegal_moves():
+    job = Job(JobSpec(argv=QUICK))
+    with pytest.raises(InvalidTransition):
+        job.transition(DONE)          # pending -> done skips running
+    with pytest.raises(InvalidTransition):
+        job.transition(PREEMPTED)     # pending -> preempted
+    job.transition(RUNNING)
+    job.transition(DONE)
+    for state in (RUNNING, PREEMPTED, FAILED):
+        with pytest.raises(InvalidTransition):
+            job.transition(state)     # terminal states are absorbing
+
+
+def test_fsm_transitions_are_counted():
+    from veles_tpu.sched.job import _metrics
+    from veles_tpu.telemetry.registry import get_registry
+    _metrics()   # mint the families before reading them back
+    reg = get_registry()
+    trans = reg.get("veles_sched_transitions_total")
+    totals = reg.get("veles_sched_jobs_total")
+    preempts = reg.get("veles_sched_preemptions_total")
+    before_run = trans.labels(tenant="metered", to=RUNNING).value
+    before_done = totals.labels(tenant="metered", state=DONE).value
+    before_pre = preempts.labels(tenant="metered").value
+    job = Job(JobSpec(argv=QUICK, tenant="metered"))
+    job.transition(RUNNING)
+    job.transition(PREEMPTED)
+    job.transition(RUNNING)
+    job.transition(DONE)
+    assert trans.labels(tenant="metered",
+                        to=RUNNING).value == before_run + 2
+    assert totals.labels(tenant="metered",
+                         state=DONE).value == before_done + 1
+    assert preempts.labels(tenant="metered").value == before_pre + 1
+
+
+# -- JobSpec -----------------------------------------------------------------
+
+
+def test_jobspec_requires_exactly_one_command_shape():
+    with pytest.raises(ValueError):
+        JobSpec()                               # neither
+    with pytest.raises(ValueError):
+        JobSpec(argv=QUICK, workflow="wf.py")   # both
+    with pytest.raises(ValueError):
+        JobSpec(argv=QUICK, qos="platinum")     # unknown QoS class
+    with pytest.raises(ValueError):
+        JobSpec(argv=QUICK, world_min=0)
+    with pytest.raises(ValueError):
+        JobSpec(argv=QUICK, world_min=4, world_max=2)
+
+
+def test_jobspec_argv_mirrors_serial_genetics_evaluator():
+    """The workflow shape must reproduce the serial evaluators' argv
+    bit-for-bit — the scheduled-genetics parity e2e rides on it."""
+    spec = JobSpec(workflow="wf.py", config="cfg.py",
+                   overrides={"root.a.lr": 0.5},
+                   result_file="/tmp/r.json", seed=7,
+                   extra_argv=["--dry-run", "exec"])
+    assert spec.build_argv(python="PY") == [
+        "PY", "-m", "veles_tpu", "wf.py", "cfg.py", "root.a.lr=0.5",
+        "--result-file", "/tmp/r.json", "-s", "7", "-v", "warning",
+        "--dry-run", "exec"]
+    # raw argv passes through verbatim (no interpreter prefix)
+    assert JobSpec(argv=["/bin/true", "x"]).build_argv() == \
+        ["/bin/true", "x"]
+
+
+def test_jobspec_dict_roundtrip_and_unknown_fields():
+    spec = JobSpec(workflow="wf.py", tenant="research",
+                   qos="interactive", weight=2.0, world_min=2,
+                   world_max=4, snapshot_dir="/tmp/snaps")
+    again = JobSpec.from_dict(spec.to_dict())
+    assert again.to_dict() == spec.to_dict()
+    assert again.preemptible
+    with pytest.raises(ValueError, match="unknown"):
+        JobSpec.from_dict({"argv": QUICK, "priority": 9})
+
+
+# -- the device pool ---------------------------------------------------------
+
+
+def test_pool_contiguous_grants_and_holes():
+    pool = DevicePool(8)
+    assert pool.allocate("a", 3) == (0, 1, 2)
+    assert pool.allocate("b", 2) == (3, 4)
+    assert pool.free == 3 and pool.holes() == [(5, 3)]
+    pool.release("a")
+    assert pool.holes() == [(0, 3), (5, 3)]
+    # 4 free-but-fragmented slots cannot host a contiguous 4-gang
+    assert pool.allocate("c", 4) is None
+    with pytest.raises(ValueError):
+        pool.allocate("b", 1)   # b already holds slots
+
+
+def test_pool_best_fit_prefers_smallest_hole():
+    pool = DevicePool(8)
+    pool.allocate("a", 2)       # 0-1
+    pool.allocate("b", 1)       # 2
+    pool.allocate("c", 3)       # 3-5
+    pool.release("b")           # holes: (2,1) and (6,2)
+    # best-fit: the 1-slot job takes the 1-slot hole, preserving the
+    # bigger hole for a bigger gang
+    assert pool.allocate("d", 1) == (2,)
+    assert pool.allocate("e", 2) == (6, 7)
+
+
+# -- scheduler behavior (manual ticks, stub gangs) ---------------------------
+
+
+def test_scheduler_places_runs_and_reaps_done():
+    sched = Scheduler(2, preempt=False)
+    job = sched.submit(JobSpec(argv=QUICK, name="noop"))
+    sched.tick()
+    assert job.state == RUNNING and job.granted_world == 1
+    assert sched.pool.held == 1
+    _tick_until(sched, lambda: job.terminal)
+    assert job.state == DONE and sched.pool.held == 0
+    stats = sched.stats()
+    assert stats["jobs"][DONE] == 1
+    assert stats["tenants"]["default"]["granted"] == 1
+
+
+def test_scheduler_failed_gang_dumps_flight_record(monkeypatch):
+    from veles_tpu.telemetry import flight
+    dumps = []
+
+    class _Recorder(object):
+        def dump(self, reason, **context):
+            dumps.append((reason, context))
+
+    monkeypatch.setattr(flight, "get_recorder", lambda: _Recorder())
+    sched = Scheduler(1, preempt=False)
+    job = sched.submit(JobSpec(argv=CRASH, name="crasher"))
+    sched.tick()
+    _tick_until(sched, lambda: job.terminal)
+    assert job.state == FAILED
+    assert "rc=3" in job.error
+    assert dumps and dumps[0][0] == "sched_job_failed"
+    assert dumps[0][1]["job"]["id"] == job.id
+
+
+def test_scheduler_gang_gets_elastic_env(tmp_path):
+    """A world-4 gang: every rank spawns with the elastic env contract
+    (rank/world/generation) the workers re-form meshes from."""
+    marker = (
+        "import os; open(%r + '/' + os.environ['VELES_ELASTIC_RANK'],"
+        " 'w').write(os.environ['VELES_ELASTIC_WORLD'] + ':' +"
+        " os.environ['VELES_ELASTIC_GEN'])" % str(tmp_path))
+    sched = Scheduler(4, preempt=False)
+    job = sched.submit(JobSpec(argv=[sys.executable, "-c", marker],
+                               world_min=2, world_max=4))
+    sched.tick()
+    assert job.granted_world == 4 and len(job.procs) == 4
+    _tick_until(sched, lambda: job.terminal)
+    assert job.state == DONE
+    ranks = sorted(os.listdir(str(tmp_path)))
+    assert ranks == ["0", "1", "2", "3"]
+    worlds = {open(os.path.join(str(tmp_path), r)).read()
+              for r in ranks}
+    assert worlds == {"4:1"}   # one grant, same generation everywhere
+
+
+def test_scheduler_rejects_oversized_and_queues_when_full():
+    sched = Scheduler(2, preempt=False)
+    with pytest.raises(ValueError, match="pool has 2"):
+        sched.submit(JobSpec(argv=QUICK, world_min=3, world_max=3))
+    hog = sched.submit(JobSpec(
+        argv=[sys.executable, "-c", "import time; time.sleep(1.0)"],
+        world_min=2, world_max=2, tenant="a"))
+    sched.tick()
+    assert hog.state == RUNNING
+    queued = sched.submit(JobSpec(argv=QUICK, tenant="b"))
+    sched.tick()
+    # no free hole and no preemption: b waits for a's gang to finish
+    assert queued.state == PENDING
+    _tick_until(sched, lambda: queued.terminal, timeout_s=60)
+    assert hog.state == DONE and queued.state == DONE
+
+
+def test_scheduler_preempts_over_share_victim_and_resumes(tmp_path):
+    """The pool-of-one contention story: a preemptible research job
+    holds the only slot; a second tenant arrives, is owed its floored
+    share of 1, and the research job is checkpoint-preempted, then
+    resumed (with priority) once the interloper finishes."""
+    sched = Scheduler(1, min_run_s=0.1)
+    victim = sched.submit(JobSpec(
+        argv=SLEEP, tenant="research",
+        snapshot_dir=str(tmp_path / "snaps")))
+    sched.tick()
+    assert victim.state == RUNNING
+    time.sleep(0.15)   # past the thrash guard
+    claimant = sched.submit(JobSpec(
+        argv=[sys.executable, "-c", "import time; time.sleep(0.3)"],
+        tenant="prod"))
+    sched.tick()
+    assert victim.state == PREEMPTED and victim.preemptions == 1
+    assert claimant.state == RUNNING
+    # the non-preemptible claimant can NOT be preempted back — the
+    # displaced job waits, then resumes the moment the slot frees
+    sched.tick()
+    assert claimant.state == RUNNING and victim.state == PREEMPTED
+    _tick_until(sched, lambda: victim.state == RUNNING, timeout_s=30)
+    assert claimant.state == DONE
+    assert victim.grants == 2
+    assert victim.preempt_resume_s is not None
+    sched.stop(kill=True)
+    assert victim.state == FAILED   # stop() takes running gangs down
+
+
+def test_scheduler_thrash_guard_blocks_fresh_victims(tmp_path):
+    sched = Scheduler(1, min_run_s=60.0)
+    incumbent = sched.submit(JobSpec(
+        argv=SLEEP, tenant="a", snapshot_dir=str(tmp_path)))
+    sched.tick()
+    newcomer = sched.submit(JobSpec(argv=QUICK, tenant="b"))
+    sched.tick()
+    # the incumbent has not run min_run_s yet: no kill, b waits
+    assert incumbent.state == RUNNING and incumbent.preemptions == 0
+    assert newcomer.state == PENDING
+    sched.stop(kill=True)
+
+
+def test_scheduler_never_preempts_own_tenant(tmp_path):
+    sched = Scheduler(1, min_run_s=0.0)
+    first = sched.submit(JobSpec(
+        argv=SLEEP, tenant="a", snapshot_dir=str(tmp_path)))
+    sched.tick()
+    time.sleep(0.05)
+    second = sched.submit(JobSpec(argv=QUICK, tenant="a"))
+    sched.tick()
+    assert first.state == RUNNING and second.state == PENDING
+    sched.stop(kill=True)
+
+
+# -- control endpoint + CLI clients ------------------------------------------
+
+
+def test_control_endpoint_and_cli_clients(capsys):
+    from veles_tpu.sched.cli import sched_main
+    sched = Scheduler(1, tick_s=0.02, preempt=False).start()
+    control = SchedulerControl(sched).start()
+    addr = "127.0.0.1:%d" % control.port
+    try:
+        # bad submits are 400s, not crashes
+        bad = urllib.request.Request(
+            "http://%s/submit" % addr,
+            data=json.dumps({"argv": QUICK, "priority": 9}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(bad, timeout=10)
+        assert err.value.code == 400
+        # the CLI submit --wait round-trip (raw command after `--`)
+        code = sched_main(["submit", "--addr", addr, "--name", "noop",
+                           "--tenant", "cli", "--wait", "--",
+                           sys.executable, "-c", "pass"])
+        assert code == 0
+        out = capsys.readouterr().out
+        assert "job-" in out and "done" in out
+        # status: both the table and raw JSON
+        assert sched_main(["status", "--addr", addr]) == 0
+        table = capsys.readouterr().out
+        assert "pool: 1 slots" in table and "tenant cli" in table
+        assert sched_main(["status", "--addr", addr, "--json"]) == 0
+        blob = json.loads(capsys.readouterr().out)
+        assert blob["status"]["pool"]["size"] == 1
+        assert blob["jobs"][0]["state"] == "done"
+    finally:
+        control.stop()
+        sched.stop()
+
+
+def test_web_status_renders_pushed_jobs():
+    from veles_tpu.web_status import WebStatusServer
+    server = WebStatusServer(host="127.0.0.1", port=0).start()
+    try:
+        server.receive_update({
+            "id": "sched-host-1", "name": "scheduler", "mode": "sched",
+            "master": "host",
+            "jobs": [{"id": "job-9", "state": "running",
+                      "tenant": "research", "world": 2}]})
+        report = server.jobs_report()
+        assert report["jobs"] == [
+            {"id": "job-9", "state": "running", "tenant": "research",
+             "world": 2, "scheduler": "sched-host-1"}]
+        port = server._server.server_address[1]
+        body = urllib.request.urlopen(
+            "http://127.0.0.1:%d/jobs.json" % port,
+            timeout=10).read().decode()
+        assert json.loads(body) == report
+    finally:
+        server.stop()
+
+
+def test_sched_alert_rules_are_wired():
+    from veles_tpu.telemetry.alerts import DEFAULT_RULES, AlertEngine
+    names = {rule["name"] for rule in DEFAULT_RULES}
+    assert {"job_stuck", "preempt_storm",
+            "tenant_starvation"} <= names
+    AlertEngine()   # every rule must construct against the registry
+
+
+# -- the atexit regression (satellite 1) -------------------------------------
+
+
+class _DummyPool(object):
+    def __init__(self, workers=1):
+        pass
+
+    def close(self):
+        pass
+
+
+def _count_atexit_registrations(monkeypatch, obj):
+    import atexit
+    from veles_tpu.parallel import warm_pool
+    calls = []
+    monkeypatch.setattr(warm_pool, "WarmPool", _DummyPool)
+    monkeypatch.setattr(atexit, "register",
+                        lambda fn, *a, **kw: calls.append(fn))
+    for _ in range(3):
+        obj._get_pool()
+        obj.close_pool()
+    return calls
+
+
+def test_genetics_registers_atexit_once(monkeypatch):
+    root.ga_atexit.x = Tune(0.0, -1.0, 1.0)
+    try:
+        opt = GeneticsOptimizer(evaluator=lambda v: 0.0)
+        assert len(_count_atexit_registrations(monkeypatch, opt)) == 1
+    finally:
+        del root.__dict__["ga_atexit"]
+
+
+def test_ensemble_registers_atexit_once(monkeypatch):
+    from veles_tpu.ensemble.base import EnsembleManagerBase
+    manager = EnsembleManagerBase(workflow_file="wf.py", size=1)
+    assert len(_count_atexit_registrations(monkeypatch, manager)) == 1
+
+
+# -- acceptance e2e: preempt/resume loss parity ------------------------------
+
+
+def _demo_argv(out, epochs=4, epoch_sleep=0.0):
+    argv = [sys.executable, "-m", "veles_tpu.parallel.elastic",
+            "worker-demo", "--out", out, "--epochs", str(epochs)]
+    if epoch_sleep:
+        argv += ["--epoch-sleep", str(epoch_sleep)]
+    return argv
+
+
+def _wait_for_manifest(snaps, timeout_s=240.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        for dirpath, _, files in os.walk(snaps):
+            if "MANIFEST.json" in files:
+                return dirpath
+        time.sleep(0.1)
+    raise AssertionError("no checkpoint manifest appeared in %s"
+                         % snaps)
+
+
+def test_preempt_resume_loss_parity(tmp_path):
+    """Two tenants, a pool of ONE device slot. The research job (4
+    epochs, preemptible) is checkpoint-preempted for a prod job, then
+    resumed from its newest complete sharded checkpoint — and its
+    final loss curve EXACTLY equals an uninterrupted run of the same
+    seeds. This is the PR 12/13 determinism contract restated as a
+    scheduling property: preemption is checkpoint + shrink, never
+    lost or repeated training."""
+    worker_env = _subprocess_env({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    base_out = str(tmp_path / "base.json")
+    base = subprocess.run(
+        _demo_argv(base_out, epoch_sleep=0.4), env=worker_env,
+        capture_output=True, timeout=300)
+    assert base.returncode == 0, base.stderr.decode(
+        errors="replace")[-3000:]
+
+    snaps = str(tmp_path / "snaps")
+    a_out = str(tmp_path / "research.json")
+    b_out = str(tmp_path / "prod.json")
+    log_dir = str(tmp_path / "logs")
+    sched = Scheduler(1, tick_s=0.05, min_run_s=0.5,
+                      log_dir=log_dir).start()
+    try:
+        research = sched.submit(JobSpec(
+            name="research-train",
+            argv=_demo_argv(a_out, epoch_sleep=0.4),
+            tenant="research", snapshot_dir=snaps, env=worker_env))
+        # wait for the generation-initial checkpoint: the preemption
+        # must be a genuine checkpoint + restore, not a fresh rebuild
+        _wait_for_manifest(snaps)
+        prod = sched.submit(JobSpec(
+            name="prod-train", argv=_demo_argv(b_out, epochs=1),
+            tenant="prod", env=worker_env))
+        states = sched.wait([research.id, prod.id], timeout_s=480)
+    finally:
+        sched.stop(kill=True)
+
+    def _logs():
+        chunks = []
+        for name in sorted(os.listdir(log_dir)):
+            with open(os.path.join(log_dir, name), "rb") as f:
+                chunks.append("%s:\n%s" % (
+                    name, f.read().decode(errors="replace")[-3000:]))
+        return "\n".join(chunks)
+
+    assert states == {research.id: DONE, prod.id: DONE}, _logs()
+    assert research.preemptions >= 1, _logs()
+    assert research.preempt_resume_s > 0.0
+    assert prod.preemptions == 0
+    # the acceptance bit: EXACT loss-curve equality with the
+    # uninterrupted baseline
+    assert json.load(open(a_out)) == json.load(open(base_out)), _logs()
+    # the prod run trained too (its own, shorter curve)
+    assert len(json.load(open(b_out))) == 1
+    # /jobs.json tells the story end to end
+    rows = {j["id"]: j for j in sched.jobs_report()["jobs"]}
+    assert rows[research.id]["preemptions"] == research.preemptions
+    assert rows[prod.id]["state"] == DONE
+
+
+# -- acceptance e2e: scheduled genetics == serial genetics -------------------
+
+
+GA_WORKFLOW = """
+import numpy
+from veles_tpu.config import root
+from veles_tpu.models.mnist import MnistWorkflow
+
+
+class TinyProvider(object):
+    def __call__(self):
+        rng = numpy.random.RandomState(0)
+        x = rng.rand(80, 6, 6).astype(numpy.float32)
+        y = (x.reshape(80, -1).sum(1) > 18).astype(numpy.int32)
+        return x[:60], y[:60], x[60:], y[60:]
+
+
+def run(load, main):
+    load(MnistWorkflow, provider=TinyProvider(), layers=(8,),
+         minibatch_size=20, max_epochs=1,
+         learning_rate=float(root.gasched.lr))
+    main()
+"""
+
+
+@pytest.fixture
+def ga_files(tmp_path):
+    wf = tmp_path / "ga_workflow.py"
+    wf.write_text(GA_WORKFLOW)
+    cfg = tmp_path / "ga_config.py"
+    cfg.write_text("root.gasched.lr = 0.05\n")
+    root.gasched.lr = Tune(0.05, 0.01, 0.5)
+    yield str(wf), str(cfg)
+    del root.__dict__["gasched"]
+
+
+def test_scheduled_genetics_matches_serial_bit_exact(ga_files):
+    """Same seeds, same PRNG stream, same per-evaluation argv — the
+    only difference is WHO runs the fitness subprocesses (the serial
+    evaluator vs concurrent scheduler jobs), so the best fitness must
+    come out bit-identical."""
+    wf, cfg = ga_files
+    serial = GeneticsOptimizer(
+        workflow_file=wf, config_file=cfg, generations=2,
+        population_size=3, seed=901,
+        rand=prng.RandomGenerator("ga-parity").seed(5))
+    serial_best = serial.run()
+
+    sched = Scheduler(3, tick_s=0.05, preempt=False).start()
+    try:
+        scheduled = ScheduledGeneticsOptimizer(
+            scheduler=sched, job_timeout_s=480,
+            workflow_file=wf, config_file=cfg, generations=2,
+            population_size=3, seed=901,
+            rand=prng.RandomGenerator("ga-parity").seed(5))
+        scheduled_best = scheduled.run()
+    finally:
+        sched.stop()
+
+    assert scheduled_best.fitness == serial_best.fitness
+    assert scheduled.overrides_for(scheduled_best) == \
+        serial.overrides_for(serial_best)
+    # every evaluation went through the scheduler as a genetics job
+    tenants = {j.spec.tenant for j in sched.jobs()}
+    assert tenants == {"genetics"}
+    assert all(j.state == DONE for j in sched.jobs())
+
+
+def test_scheduled_ensemble_trains_members_concurrently(tmp_path):
+    """The second native tenant: ensemble members as scheduler jobs,
+    keeping the serial manager's gathered-results contract."""
+    wf = tmp_path / "ens_workflow.py"
+    wf.write_text(GA_WORKFLOW.replace(
+        "learning_rate=float(root.gasched.lr)", "learning_rate=0.1"))
+    gathered = str(tmp_path / "ensemble.json")
+    sched = Scheduler(2, tick_s=0.05, preempt=False).start()
+    try:
+        manager = ScheduledEnsembleTrainManager(
+            scheduler=sched, job_timeout_s=480,
+            workflow_file=str(wf), size=2, result_file=gathered)
+        results = manager.run()
+    finally:
+        sched.stop()
+    assert len(results) == 2
+    assert all(isinstance(r, dict) and "best_n_err_pt" in r
+               for r in results), results
+    blob = json.load(open(gathered))
+    assert blob["size"] == 2 and len(blob["models"]) == 2
+    jobs = sched.jobs()
+    assert {j.spec.tenant for j in jobs} == {"ensemble"}
+    assert all(j.state == DONE for j in jobs)

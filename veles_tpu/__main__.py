@@ -350,6 +350,10 @@ class Main(Logger):
             # the training parser rejects them
             from veles_tpu.serving.frontend import main as serve_main
             return serve_main(argv[1:])
+        if argv and argv[0] == "sched":
+            # same for the gang scheduler's serve/submit/status surface
+            from veles_tpu.sched.cli import sched_main
+            return sched_main(argv[1:])
         parser = self.init_parser()
         # intermixed: bare k=v override positionals legally FOLLOW
         # options (the ensemble/genetics evaluators build argv that

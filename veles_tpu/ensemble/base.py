@@ -43,17 +43,22 @@ class EnsembleManagerBase(Distributable, IDistributable):
         super(EnsembleManagerBase, self).init_unpickled()
         self._pending_ = {}
         self._pool_ = None
+        self._atexit_registered_ = False
 
     def _get_pool(self):
         if self._pool_ is None:
-            import atexit
-
             from veles_tpu.parallel.warm_pool import WarmPool
             self._pool_ = WarmPool(workers=1)
             # slaves evaluate via generate_data_for_master and never
             # enter run()'s finally — make sure the evaluator process
-            # is reaped at interpreter exit regardless
-            atexit.register(self.close_pool)
+            # is reaped at interpreter exit regardless. Registered
+            # ONCE per instance: close_pool nulls _pool_, so repeated
+            # run() cycles re-create the pool and would otherwise
+            # stack a stale atexit entry per recreation
+            if not self._atexit_registered_:
+                import atexit
+                atexit.register(self.close_pool)
+                self._atexit_registered_ = True
         return self._pool_
 
     def close_pool(self):

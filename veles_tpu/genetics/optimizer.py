@@ -129,13 +129,18 @@ class GeneticsOptimizer(Distributable, IDistributable):
 
     def _get_pool(self):
         if self._pool_ is None:
-            import atexit
-
             from veles_tpu.parallel.warm_pool import WarmPool
             self._pool_ = WarmPool(workers=1)
             # slave-mode evaluations never pass through run()'s
-            # finally — reap the evaluator at interpreter exit too
-            atexit.register(self.close_pool)
+            # finally — reap the evaluator at interpreter exit too.
+            # Registered ONCE per instance: a close_pool/_get_pool
+            # cycle (every run(); each scheduler-driven generation)
+            # must not stack a fresh atexit entry pinning this
+            # optimizer alive per recreation
+            if not self._atexit_registered_:
+                import atexit
+                atexit.register(self.close_pool)
+                self._atexit_registered_ = True
         return self._pool_
 
     def close_pool(self):
@@ -261,6 +266,7 @@ class GeneticsOptimizer(Distributable, IDistributable):
         super(GeneticsOptimizer, self).init_unpickled()
         self._dispatched_ = {}
         self._pool_ = None
+        self._atexit_registered_ = False
 
     @property
     def has_data_for_slave(self):

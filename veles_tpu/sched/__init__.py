@@ -1,0 +1,37 @@
+"""Training as a service: a multi-job gang scheduler over the elastic
+mesh (ROADMAP item 4).
+
+The elastic supervisor (PR 13) re-forms a mesh at any world size,
+reshard-on-restore is bit-exact (PR 15), and serving already does
+per-tenant weighted-fair QoS (PR 14) — so the cluster stops being
+dedicated to one job. This package packs many training jobs onto one
+device pool:
+
+* :mod:`veles_tpu.sched.job` — :class:`JobSpec` (workflow + config
+  overrides + tenant + QoS + elastic world-size range) and the job FSM
+  (``PENDING -> RUNNING -> PREEMPTED -> DONE/FAILED``), every
+  transition counted in ``veles_sched_*`` metric families;
+* :mod:`veles_tpu.sched.scheduler` — device-inventory pool, gang
+  placement of contiguous mesh slices, weighted-fair per-tenant quotas
+  through the shared :mod:`veles_tpu.fairshare` ledger, preemption =
+  checkpoint + shrink (the per-epoch sharded-checkpoint seam), resume
+  = re-form at the granted size + reshard-on-restore — a preempted
+  job's loss curve is bit-identical to an uninterrupted run;
+* :mod:`veles_tpu.sched.tenants` — the first native tenants: the
+  genetic optimizer submits a whole generation of fitness evaluations
+  as concurrent jobs, the ensemble trainer submits its members the
+  same way;
+* :mod:`veles_tpu.sched.cli` — ``python -m veles_tpu sched
+  serve|submit|status``.
+"""
+
+from veles_tpu.sched.job import (DONE, FAILED, PENDING, PREEMPTED,
+                                 RUNNING, Job, JobSpec)
+from veles_tpu.sched.scheduler import (DevicePool, Scheduler,
+                                       SchedulerControl)
+from veles_tpu.sched.tenants import (ScheduledEnsembleTrainManager,
+                                     ScheduledGeneticsOptimizer)
+
+__all__ = ["JobSpec", "Job", "PENDING", "RUNNING", "PREEMPTED", "DONE",
+           "FAILED", "DevicePool", "Scheduler", "SchedulerControl",
+           "ScheduledGeneticsOptimizer", "ScheduledEnsembleTrainManager"]

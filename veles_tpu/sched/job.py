@@ -1,0 +1,275 @@
+"""JobSpec + the job FSM for the multi-job gang scheduler.
+
+A *job* is one training run packed onto the shared device pool: a
+workflow invocation (or a raw command) owned by a tenant, wanting an
+elastic gang of ``world_min..world_max`` device slots. Its lifecycle
+is a small FSM::
+
+    PENDING --> RUNNING --> DONE
+                  |  ^         \\-> FAILED
+                  v  |
+               PREEMPTED -------/
+
+``RUNNING -> PREEMPTED`` is checkpoint + shrink (the gang is killed;
+its last complete per-epoch sharded checkpoint is the resume point)
+and ``PREEMPTED -> RUNNING`` is re-form + reshard-on-restore — the
+PR 12/13 determinism contract makes the resumed loss curve
+bit-identical to an uninterrupted run. Every transition lands in the
+``veles_sched_transitions_total`` counter; terminal states also count
+into ``veles_sched_jobs_total``.
+"""
+
+import itertools
+import sys
+import time
+
+from veles_tpu.fairshare import DEFAULT_QOS, QOS_MULTIPLIER
+
+#: FSM states (string-valued: they travel through /jobs.json verbatim)
+PENDING = "pending"
+RUNNING = "running"
+PREEMPTED = "preempted"
+DONE = "done"
+FAILED = "failed"
+
+STATES = (PENDING, RUNNING, PREEMPTED, DONE, FAILED)
+
+#: legal FSM moves; anything else is a scheduler bug, not a runtime
+#: condition — transition() raises instead of recording garbage
+TRANSITIONS = {
+    PENDING: (RUNNING, FAILED),
+    RUNNING: (PREEMPTED, DONE, FAILED),
+    PREEMPTED: (RUNNING, FAILED),
+    DONE: (),
+    FAILED: (),
+}
+
+DEFAULT_TENANT = "default"
+
+_ids = itertools.count(1)
+
+
+def _metrics():
+    from veles_tpu.telemetry.registry import get_registry
+    r = get_registry()
+    return {
+        "transitions": r.counter(
+            "veles_sched_transitions_total",
+            "Job FSM transitions", labels=("tenant", "to")),
+        "jobs": r.gauge(
+            "veles_sched_jobs", "Jobs per FSM state",
+            labels=("state",)),
+        "jobs_total": r.counter(
+            "veles_sched_jobs_total",
+            "Jobs reaching a terminal state",
+            labels=("tenant", "state")),
+        "preemptions": r.counter(
+            "veles_sched_preemptions_total",
+            "Jobs preempted (checkpoint + shrink)",
+            labels=("tenant",)),
+        "preempt_resume": r.histogram(
+            "veles_sched_preempt_resume_ms",
+            "Preemption -> the job is RUNNING again (re-form + "
+            "reshard-on-restore)"),
+        "devices": r.gauge(
+            "veles_sched_pool_devices",
+            "Device-slot inventory by state", labels=("state",)),
+        "oldest_wait": r.gauge(
+            "veles_sched_oldest_pending_s",
+            "Age of the oldest PENDING/PREEMPTED job (feeds "
+            "job_stuck)"),
+        "tenant_wait": r.gauge(
+            "veles_sched_tenant_wait_s",
+            "Oldest runnable-job wait per tenant (feeds "
+            "tenant_starvation)", labels=("tenant",)),
+    }
+
+
+class InvalidTransition(RuntimeError):
+    """The scheduler asked for an FSM move the table forbids."""
+
+
+class JobSpec(object):
+    """What to run, who owns it, and how elastic it is.
+
+    Two command shapes:
+
+    * ``workflow`` (+ ``config`` + ``overrides`` + ``result_file`` +
+      ``seed`` + ``extra_argv``) — a ``python -m veles_tpu`` run whose
+      module argv is built EXACTLY like the genetics/ensemble serial
+      evaluators build theirs (same ``path=repr(value)`` overrides,
+      same flag order), so a scheduled evaluation is bit-identical to
+      a serial one;
+    * ``argv`` — a raw command executed verbatim (the elastic
+      worker-demo, bench workers, anything already on disk).
+
+    ``world_min..world_max`` is the elastic gang range: the scheduler
+    grants the largest contiguous slice in range that fits, and a
+    resume may be granted a DIFFERENT size — reshard-on-restore makes
+    that safe. ``snapshot_dir`` marks the job preemptible: workers get
+    it as ``VELES_ELASTIC_SNAPSHOTS`` and cut per-epoch sharded
+    checkpoints; a job without one is never chosen as a preemption
+    victim (there is nothing to resume it from).
+    """
+
+    def __init__(self, name=None, argv=None, workflow=None, config=None,
+                 overrides=None, extra_argv=(), result_file=None,
+                 seed=None, tenant=DEFAULT_TENANT, qos=DEFAULT_QOS,
+                 weight=1.0, world_min=1, world_max=None,
+                 snapshot_dir=None, env=None):
+        if (argv is None) == (workflow is None):
+            raise ValueError(
+                "exactly one of argv / workflow must be given")
+        if qos not in QOS_MULTIPLIER:
+            raise ValueError("unknown QoS class %r (one of %s)"
+                             % (qos, sorted(QOS_MULTIPLIER)))
+        self.name = name or (workflow or argv[0])
+        self.argv = list(argv) if argv else None
+        self.workflow = workflow
+        self.config = config
+        self.overrides = dict(overrides or {})
+        self.extra_argv = list(extra_argv)
+        self.result_file = result_file
+        self.seed = seed
+        self.tenant = tenant or DEFAULT_TENANT
+        self.qos = qos
+        self.weight = float(weight)
+        self.world_min = int(world_min)
+        self.world_max = int(world_max if world_max is not None
+                             else world_min)
+        if not 1 <= self.world_min <= self.world_max:
+            raise ValueError("need 1 <= world_min <= world_max (got "
+                             "%d..%d)" % (self.world_min,
+                                          self.world_max))
+        self.snapshot_dir = snapshot_dir
+        self.env = dict(env or {})
+
+    @property
+    def preemptible(self):
+        return self.snapshot_dir is not None
+
+    def build_argv(self, python=None):
+        """The full command for one gang member. The workflow shape
+        mirrors ``GeneticsOptimizer._evaluate_subprocess`` /
+        ``EnsembleManagerBase._base_argv`` ordering bit-for-bit."""
+        if self.argv is not None:
+            return list(self.argv)
+        argv = [self.workflow]
+        if self.config:
+            argv.append(self.config)
+        argv.extend("%s=%r" % (path, value)
+                    for path, value in self.overrides.items())
+        if self.result_file:
+            argv.extend(["--result-file", self.result_file])
+        if self.seed is not None:
+            argv.extend(["-s", str(self.seed)])
+        argv.extend(["-v", "warning"])
+        argv.extend(self.extra_argv)
+        return [python or sys.executable, "-m", "veles_tpu"] + argv
+
+    def to_dict(self):
+        """JSON body for ``sched submit`` -> the control endpoint."""
+        return {
+            "name": self.name, "argv": self.argv,
+            "workflow": self.workflow, "config": self.config,
+            "overrides": self.overrides, "extra_argv": self.extra_argv,
+            "result_file": self.result_file, "seed": self.seed,
+            "tenant": self.tenant, "qos": self.qos,
+            "weight": self.weight, "world_min": self.world_min,
+            "world_max": self.world_max,
+            "snapshot_dir": self.snapshot_dir, "env": self.env,
+        }
+
+    @classmethod
+    def from_dict(cls, data):
+        known = ("name", "argv", "workflow", "config", "overrides",
+                 "extra_argv", "result_file", "seed", "tenant", "qos",
+                 "weight", "world_min", "world_max", "snapshot_dir",
+                 "env")
+        unknown = set(data) - set(known)
+        if unknown:
+            raise ValueError("unknown JobSpec fields %s"
+                             % sorted(unknown))
+        return cls(**{k: data[k] for k in known if data.get(k)
+                      is not None})
+
+
+class Job(object):
+    """One submitted job: spec + FSM state + grant bookkeeping."""
+
+    def __init__(self, spec, metrics=None, now=None):
+        self.id = "job-%d" % next(_ids)
+        self.spec = spec
+        self.state = PENDING
+        self.submitted_t = time.time() if now is None else now
+        #: when the job last became runnable (PENDING or PREEMPTED) —
+        #: the wait-age gauges and starvation alerts key off this
+        self.runnable_since = self.submitted_t
+        self.started_t = None
+        self.finished_t = None
+        self.preempted_t = None        # perf_counter at last preempt
+        self.preempt_resume_s = None   # last measured preempt->resume
+        self.granted_world = 0
+        self.slots = ()
+        self.procs = []
+        self.grants = 0                # ENV_GEN generation counter
+        self.preemptions = 0
+        self.error = None
+        self.history = [(self.submitted_t, PENDING)]
+        self._metrics = metrics if metrics is not None else _metrics()
+
+    @property
+    def runnable(self):
+        return self.state in (PENDING, PREEMPTED)
+
+    @property
+    def terminal(self):
+        return self.state in (DONE, FAILED)
+
+    def transition(self, to, now=None):
+        """One FSM move; counts the ``veles_sched_*`` families."""
+        now = time.time() if now is None else now
+        if to not in TRANSITIONS[self.state]:
+            raise InvalidTransition(
+                "%s: illegal transition %s -> %s" % (self.id,
+                                                     self.state, to))
+        self.state = to
+        self.history.append((now, to))
+        self._metrics["transitions"].labels(
+            tenant=self.spec.tenant, to=to).inc()
+        if to == RUNNING:
+            if self.started_t is None:
+                self.started_t = now
+            if self.preempted_t is not None:
+                self.preempt_resume_s = \
+                    time.perf_counter() - self.preempted_t
+                self._metrics["preempt_resume"].observe(
+                    self.preempt_resume_s * 1e3)
+                self.preempted_t = None
+        elif to == PREEMPTED:
+            self.preemptions += 1
+            self.preempted_t = time.perf_counter()
+            self.runnable_since = now
+            self._metrics["preemptions"].labels(
+                tenant=self.spec.tenant).inc()
+        if to in (DONE, FAILED):
+            self.finished_t = now
+            self._metrics["jobs_total"].labels(
+                tenant=self.spec.tenant, state=to).inc()
+        return self
+
+    def to_dict(self):
+        """The /jobs.json row."""
+        return {
+            "id": self.id, "name": self.spec.name,
+            "tenant": self.spec.tenant, "qos": self.spec.qos,
+            "state": self.state, "world": self.granted_world,
+            "world_range": [self.spec.world_min, self.spec.world_max],
+            "slots": list(self.slots),
+            "submitted_t": self.submitted_t,
+            "started_t": self.started_t,
+            "finished_t": self.finished_t,
+            "preemptions": self.preemptions,
+            "preempt_resume_s": self.preempt_resume_s,
+            "error": self.error,
+        }

@@ -1,0 +1,554 @@
+"""The gang scheduler: device pool + weighted-fair quotas + preemption.
+
+One :class:`Scheduler` owns a :class:`DevicePool` of N device slots and
+packs submitted :class:`~veles_tpu.sched.job.Job` gangs onto it:
+
+* **gang placement** — a job wants ``world_min..world_max`` slots; the
+  scheduler grants the LARGEST contiguous slice in range that fits
+  (contiguous because a mesh slice is an ICI neighborhood, not a bag
+  of devices), best-fit among the free holes so big holes survive for
+  big gangs;
+* **weighted-fair quotas** — per-tenant :class:`ShareAccount` ledgers
+  from :mod:`veles_tpu.fairshare`, the SAME math the serving
+  AdmissionController meters samples with, here metering device slots:
+  a tenant under its guaranteed share always places (slots permitting);
+  over-share placement may only borrow headroom no active tenant holds
+  a claim on;
+* **preemption = checkpoint + shrink** — a preemptible job (one with a
+  ``snapshot_dir``) cuts a per-epoch sharded checkpoint through the
+  elastic seam (``save_elastic_checkpoint`` riding
+  ``snapshotter.save_snapshot_sharded``), so preempting it is the
+  ElasticSupervisor kill: SIGKILL the gang's process groups. Resume
+  respawns at the newly granted world size with the same snapshot
+  directory — ``run_elastic_training`` restores the newest complete
+  generation and reshard-on-restore re-partitions it, making the
+  resumed loss curve bit-identical to an uninterrupted run (the
+  PR 12/13 invariant, proven at this tier by
+  ``tests/test_sched.py::test_preempt_resume_loss_parity``);
+* a failed gang dumps a flight record (``sched_job_failed``) before
+  the job lands in FAILED.
+
+:class:`SchedulerControl` is the loopback HTTP surface the CLI talks
+to: ``POST /submit`` (a JobSpec dict), ``GET /status``,
+``GET /jobs.json``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from veles_tpu.fairshare import (DEFAULT_QOS, ShareAccount,
+                                 guaranteed_share, reserved_claim)
+from veles_tpu.logger import Logger
+from veles_tpu.parallel.elastic import (ENV_COORD, ENV_GEN, ENV_RANK,
+                                        ENV_SNAPSHOTS, ENV_WORLD,
+                                        _free_port)
+from veles_tpu.sched.job import (DONE, FAILED, PENDING, PREEMPTED,
+                                 RUNNING, STATES, Job, _metrics)
+
+
+class DevicePool(object):
+    """Slot inventory: ``size`` device slots, contiguous gang grants.
+
+    Holes are tracked implicitly (the complement of held intervals);
+    :meth:`allocate` is best-fit — the SMALLEST hole that still fits
+    the gang — so one small job does not fragment the hole a large
+    gang is waiting for.
+    """
+
+    def __init__(self, size):
+        if int(size) < 1:
+            raise ValueError("pool size must be > 0 (got %s)" % size)
+        self.size = int(size)
+        self._held = {}  # job_id -> (start, n)
+
+    @property
+    def held(self):
+        return sum(n for _, n in self._held.values())
+
+    @property
+    def free(self):
+        return self.size - self.held
+
+    def holes(self):
+        """Free contiguous ``(start, length)`` runs, ascending."""
+        taken = sorted(self._held.values())
+        holes, cursor = [], 0
+        for start, n in taken:
+            if start > cursor:
+                holes.append((cursor, start - cursor))
+            cursor = max(cursor, start + n)
+        if cursor < self.size:
+            holes.append((cursor, self.size - cursor))
+        return holes
+
+    def allocate(self, job_id, want):
+        """Grant ``want`` contiguous slots to ``job_id`` (best-fit),
+        or return ``None`` when no hole is big enough."""
+        if job_id in self._held:
+            raise ValueError("%s already holds slots" % job_id)
+        best = None
+        for start, length in self.holes():
+            if length >= want and (best is None or length < best[1]):
+                best = (start, length)
+        if best is None:
+            return None
+        self._held[job_id] = (best[0], want)
+        return tuple(range(best[0], best[0] + want))
+
+    def release(self, job_id):
+        self._held.pop(job_id, None)
+
+
+class Scheduler(Logger):
+    """Multi-job gang scheduler over one device pool."""
+
+    def __init__(self, pool_size, tick_s=0.2, preempt=True,
+                 min_run_s=1.0, activity_window_s=10.0, python=None,
+                 log_dir=None):
+        super(Scheduler, self).__init__()
+        self.pool = DevicePool(pool_size)
+        self.tick_s = float(tick_s)
+        self.preempt_enabled = bool(preempt)
+        #: thrash guard: a job must RUN this long before it can be
+        #: chosen as a victim — with it, mutual preemption degrades
+        #: into round-robin time slices of at least min_run_s, not a
+        #: kill storm
+        self.min_run_s = float(min_run_s)
+        self.activity_window_s = float(activity_window_s)
+        self.python = python or sys.executable
+        self.log_dir = log_dir
+        self._lock = threading.RLock()
+        self._jobs = {}        # id -> Job (insertion = submission order)
+        self._accounts = {}    # tenant -> ShareAccount
+        self._grant_seq = 0
+        self._metrics = _metrics()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, spec, now=None):
+        now = time.time() if now is None else now
+        if spec.world_max > self.pool.size:
+            raise ValueError(
+                "job wants up to %d slots but the pool has %d"
+                % (spec.world_max, self.pool.size))
+        with self._lock:
+            job = Job(spec, metrics=self._metrics, now=now)
+            self._jobs[job.id] = job
+            account = self._account(spec.tenant, spec)
+            account.last_active = now
+            self.info("submitted %s (%s): tenant=%s qos=%s world=%d..%d"
+                      "%s", job.id, spec.name, spec.tenant, spec.qos,
+                      spec.world_min, spec.world_max,
+                      " preemptible" if spec.preemptible else "")
+        return job
+
+    def _account(self, tenant, spec=None):
+        account = self._accounts.get(tenant)
+        if account is None:
+            account = self._accounts[tenant] = ShareAccount(
+                tenant, weight=spec.weight if spec else 1.0,
+                qos=spec.qos if spec else DEFAULT_QOS)
+        elif spec is not None:
+            # latest submission's weight/qos wins (one account per
+            # tenant; jobs are the granularity specs ride in on)
+            account.weight = spec.weight
+            account.qos = spec.qos
+        return account
+
+    def jobs(self):
+        with self._lock:
+            return list(self._jobs.values())
+
+    def get(self, job_id):
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def wait(self, job_ids, timeout_s=None, poll_s=0.05):
+        """Block until every listed job is terminal (DONE/FAILED).
+        Returns ``{id: state}``; raises ``TimeoutError`` on timeout.
+        Requires a started scheduler (the tick thread does the work)."""
+        deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        ids = list(job_ids)
+        while True:
+            with self._lock:
+                jobs = [self._jobs[i] for i in ids]
+                if all(j.terminal for j in jobs):
+                    return {j.id: j.state for j in jobs}
+            if deadline and time.monotonic() > deadline:
+                raise TimeoutError(
+                    "jobs still not terminal after %.0fs: %s"
+                    % (timeout_s, [j.id for j in jobs
+                                   if not j.terminal]))
+            time.sleep(poll_s)
+
+    # -- the tick ----------------------------------------------------------
+
+    def tick(self, now=None):
+        """One scheduling pass: reap finished gangs, place runnable
+        jobs (preempting when fair-share justifies it), publish the
+        gauges. The loop calls this; tests drive it directly."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._reap_locked(now)
+            self._schedule_locked(now)
+            self._publish_locked(now)
+
+    def _reap_locked(self, now):
+        for job in self._jobs.values():
+            if job.state != RUNNING:
+                continue
+            codes = [proc.poll() for proc in job.procs]
+            if any(code not in (None, 0) for code in codes):
+                # one gang member died: the rest are wedged in (or
+                # heading into) a dead collective — take the gang down
+                self._kill_gang(job)
+                self._release_locked(job, now)
+                job.error = "worker exited rc=%s" % (
+                    [c for c in codes if c not in (None, 0)][0],)
+                job.transition(FAILED, now)
+                self.warning("%s failed: %s", job.id, job.error)
+                from veles_tpu.telemetry.flight import get_recorder
+                get_recorder().dump("sched_job_failed",
+                                    job=job.to_dict(), rc=codes)
+            elif all(code == 0 for code in codes):
+                self._release_locked(job, now)
+                job.transition(DONE, now)
+                self.info("%s done (world=%d, %d preemption%s)",
+                          job.id, job.granted_world, job.preemptions,
+                          "" if job.preemptions == 1 else "s")
+
+    def _schedule_locked(self, now):
+        # resumes first (a preempted job already earned its slot once),
+        # oldest-runnable first within each class
+        runnable = [j for j in self._jobs.values() if j.runnable]
+        runnable.sort(key=lambda j: (j.state != PREEMPTED,
+                                     j.runnable_since))
+        for job in runnable:
+            if self._try_place_locked(job, now):
+                continue
+            if self.preempt_enabled and \
+                    self._try_preempt_for_locked(job, now):
+                self._try_place_locked(job, now)
+
+    def _gate_locked(self, account, want, now):
+        """The fair-share admission gate for ``want`` more slots."""
+        accounts = self._accounts.values()
+        share = guaranteed_share(self.pool.size, account, accounts,
+                                 now, self.activity_window_s)
+        if account.outstanding + want <= share:
+            return True
+        reserved = reserved_claim(self.pool.size, account, accounts,
+                                  now, self.activity_window_s)
+        return want <= self.pool.size - self.pool.held - reserved
+
+    def _try_place_locked(self, job, now):
+        account = self._accounts[job.spec.tenant]
+        for want in range(min(job.spec.world_max, self.pool.free),
+                          job.spec.world_min - 1, -1):
+            if not self._gate_locked(account, want, now):
+                continue
+            slots = self.pool.allocate(job.id, want)
+            if slots is None:
+                continue
+            try:
+                self._spawn_locked(job, slots, now)
+            except OSError as e:
+                self.pool.release(job.id)
+                job.error = "spawn failed: %s" % e
+                job.transition(FAILED, now)
+                return False
+            account.outstanding += want
+            account.admitted_total += want
+            account.last_active = now
+            return True
+        return False
+
+    def _try_preempt_for_locked(self, job, now):
+        """Preempt ONE victim gang to make room for ``job``, when the
+        fair-share ledger justifies it: the claimant tenant is under
+        its guaranteed share, the victim's tenant is at-or-over its
+        own, and the victim has run at least ``min_run_s`` (the
+        thrash guard that turns contention into time slices)."""
+        account = self._accounts[job.spec.tenant]
+        accounts = self._accounts.values()
+        share = guaranteed_share(self.pool.size, account, accounts,
+                                 now, self.activity_window_s)
+        if account.outstanding + job.spec.world_min > share:
+            return False            # not owed anything — wait, don't kill
+        victims = []
+        for other in self._jobs.values():
+            if other.state != RUNNING or not other.spec.preemptible:
+                continue
+            if other.spec.tenant == job.spec.tenant:
+                continue
+            if now - other.history[-1][0] < self.min_run_s:
+                continue
+            v_account = self._accounts[other.spec.tenant]
+            v_share = guaranteed_share(self.pool.size, v_account,
+                                       accounts, now,
+                                       self.activity_window_s)
+            if v_account.outstanding < v_share:
+                continue            # that tenant is within its guarantee
+            victims.append((v_account.outstanding - v_share,
+                            other.history[-1][0], other))
+        if not victims:
+            return False
+        # most over-share tenant first; within it, the most recently
+        # (re)started gang loses the least completed work
+        victims.sort(key=lambda v: (-v[0], -v[1]))
+        victim = victims[0][2]
+        self.info("preempting %s (tenant %s) for %s (tenant %s) — "
+                  "checkpoint + shrink", victim.id, victim.spec.tenant,
+                  job.id, job.spec.tenant)
+        self._kill_gang(victim)
+        self._release_locked(victim, now)
+        victim.transition(PREEMPTED, now)
+        return True
+
+    # -- gang lifecycle ----------------------------------------------------
+
+    def _spawn_locked(self, job, slots, now):
+        world = len(slots)
+        self._grant_seq += 1
+        job.grants += 1
+        coord = None
+        if world > 1:
+            coord = "127.0.0.1:%d" % _free_port()
+        argv = job.spec.build_argv(python=self.python)
+        procs = []
+        for rank in range(world):
+            env = dict(os.environ)
+            env.update(job.spec.env)
+            env[ENV_GEN] = str(self._grant_seq)
+            env[ENV_WORLD] = str(world)
+            env[ENV_RANK] = str(rank)
+            if coord:
+                env[ENV_COORD] = coord
+            else:
+                env.pop(ENV_COORD, None)
+            if job.spec.snapshot_dir:
+                env[ENV_SNAPSHOTS] = job.spec.snapshot_dir
+            stdout = stderr = None
+            logf = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                logf = open(os.path.join(
+                    self.log_dir, "%s-g%d-r%d.log"
+                    % (job.id, job.grants, rank)), "ab")
+                stdout = stderr = logf
+            try:
+                procs.append(subprocess.Popen(
+                    argv, env=env, stdout=stdout, stderr=stderr,
+                    start_new_session=True))
+            finally:
+                if logf is not None:
+                    logf.close()   # the child keeps its own dup
+        job.slots = slots
+        job.granted_world = world
+        job.procs = procs
+        job.transition(RUNNING, now)
+        self.info("%s: granted slots %s (world=%d, grant #%d)",
+                  job.id, list(slots), world, job.grants)
+
+    def _kill_gang(self, job):
+        """The ElasticSupervisor kill: SIGKILL each member's process
+        group (workers run in their own sessions) — per-epoch sharded
+        checkpoints make this checkpoint + shrink, not data loss."""
+        for proc in job.procs:
+            if proc.poll() is not None:
+                continue
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+        for proc in job.procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def _release_locked(self, job, now):
+        if not job.granted_world:
+            return
+        account = self._accounts[job.spec.tenant]
+        account.outstanding = max(
+            0, account.outstanding - job.granted_world)
+        account.completions.append(now)
+        account.last_active = now
+        self.pool.release(job.id)
+        job.slots = ()
+        job.granted_world = 0
+        job.procs = []
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _publish_locked(self, now):
+        counts = dict.fromkeys(STATES, 0)
+        oldest = 0.0
+        waits = {}
+        for job in self._jobs.values():
+            counts[job.state] += 1
+            if job.runnable:
+                wait = now - job.runnable_since
+                oldest = max(oldest, wait)
+                tenant = job.spec.tenant
+                waits[tenant] = max(waits.get(tenant, 0.0), wait)
+        for state, n in counts.items():
+            self._metrics["jobs"].labels(state=state).set(n)
+        self._metrics["devices"].labels(state="free").set(
+            self.pool.free)
+        self._metrics["devices"].labels(state="held").set(
+            self.pool.held)
+        self._metrics["oldest_wait"].set(oldest)
+        for tenant in self._accounts:
+            self._metrics["tenant_wait"].labels(tenant=tenant).set(
+                waits.get(tenant, 0.0))
+
+    def stats(self, now=None):
+        now = time.time() if now is None else now
+        with self._lock:
+            counts = dict.fromkeys(STATES, 0)
+            for job in self._jobs.values():
+                counts[job.state] += 1
+            return {
+                "pool": {"size": self.pool.size,
+                         "free": self.pool.free,
+                         "held": self.pool.held},
+                "jobs": counts,
+                "tenants": {
+                    a.name: {
+                        "weight": a.weight, "qos": a.qos,
+                        "held": a.outstanding,
+                        "granted": a.admitted_total,
+                        "share": round(guaranteed_share(
+                            self.pool.size, a, self._accounts.values(),
+                            now, self.activity_window_s), 1),
+                    } for a in self._accounts.values()},
+            }
+
+    def jobs_report(self):
+        """The ``/jobs.json`` body (also what a dashboard push
+        embeds as its ``jobs`` list)."""
+        with self._lock:
+            return {"jobs": [job.to_dict()
+                             for job in self._jobs.values()]}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """Run the tick loop on a daemon thread."""
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="sched-tick")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.tick_s):
+            try:
+                self.tick()
+            except Exception:
+                self.exception("scheduler tick failed")
+
+    def stop(self, kill=True):
+        """Stop the loop; ``kill`` takes down every running gang (a
+        drain would wait for them — the caller owns that choice)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if kill:
+            with self._lock:
+                for job in self._jobs.values():
+                    if job.state == RUNNING:
+                        self._kill_gang(job)
+                        self._release_locked(job, time.time())
+                        job.error = "scheduler stopped"
+                        job.transition(FAILED)
+
+
+class _ControlHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        self.server.owner.debug("http: " + fmt, *args)
+
+    def _reply(self, body, code=200):
+        data = json.dumps(body).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        scheduler = self.server.owner.scheduler
+        if self.path.startswith("/status"):
+            self._reply(scheduler.stats())
+        elif self.path.startswith("/jobs.json"):
+            self._reply(scheduler.jobs_report())
+        else:
+            self._reply({"error": "not found"}, code=404)
+
+    def do_POST(self):
+        if not self.path.startswith("/submit"):
+            self._reply({"error": "not found"}, code=404)
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            data = json.loads(self.rfile.read(length).decode("utf-8"))
+            from veles_tpu.sched.job import JobSpec
+            job = self.server.owner.scheduler.submit(
+                JobSpec.from_dict(data))
+        except (TypeError, ValueError, KeyError) as e:
+            self._reply({"error": str(e) or type(e).__name__},
+                        code=400)
+            return
+        self._reply({"id": job.id, "state": job.state})
+
+
+class SchedulerControl(Logger):
+    """Loopback HTTP control plane for one scheduler: ``POST
+    /submit``, ``GET /status``, ``GET /jobs.json``. Binds loopback by
+    default — the submit surface executes commands, so exposing it
+    beyond the host is an operator's explicit choice."""
+
+    def __init__(self, scheduler, host="127.0.0.1", port=0):
+        super(SchedulerControl, self).__init__()
+        self.scheduler = scheduler
+        self._server = ThreadingHTTPServer((host, port),
+                                           _ControlHandler)
+        self._server.owner = self
+        self._server.daemon_threads = True
+        self.address = self._server.server_address
+        self._thread = None
+
+    @property
+    def port(self):
+        return self.address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="sched-control")
+        self._thread.start()
+        self.info("scheduler control on %s:%d", *self.address)
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -36,18 +36,20 @@ Telemetry: ``veles_serving_tenant_{admitted,shed}_total{tenant,qos}``,
 ``tenant_shed_burn`` alert rule watches.
 """
 
-import collections
 import math
 import threading
 import time
 
+# the share math itself lives in veles_tpu/fairshare.py — ONE ledger
+# shared with the training scheduler (veles_tpu/sched); QOS_MULTIPLIER
+# and DEFAULT_QOS stay importable from here for compatibility
+from veles_tpu.fairshare import (DEFAULT_QOS, QOS_MULTIPLIER,
+                                 ShareAccount, guaranteed_share,
+                                 reserved_claim)
 from veles_tpu.logger import Logger
 from veles_tpu.serving.engine import EngineOverloaded
 from veles_tpu.telemetry.registry import get_registry
 
-#: QoS class -> weight multiplier; order is also the shed priority
-QOS_MULTIPLIER = {"interactive": 4.0, "batch": 2.0, "best_effort": 1.0}
-DEFAULT_QOS = "batch"
 DEFAULT_TENANT = "default"
 
 #: hard bound on distinct tenant buckets: the ``X-Tenant`` header is
@@ -74,47 +76,10 @@ class TenantOverloaded(EngineOverloaded):
         self.tenant = tenant
 
 
-class _Tenant(object):
-    """Accounting for one tenant: outstanding, drain rate, windows."""
-
-    __slots__ = ("name", "weight", "qos", "outstanding", "last_active",
-                 "completions", "decisions", "shed_window",
-                 "admitted_total", "shed_total")
-
-    def __init__(self, name, weight=1.0, qos=DEFAULT_QOS):
-        self.name = name
-        self.weight = float(weight)
-        self.qos = qos
-        self.outstanding = 0
-        self.last_active = 0.0
-        self.completions = collections.deque()   # (t,) drain window
-        self.decisions = collections.deque(maxlen=256)  # 1 admit/0 shed
-        self.shed_window = 0    # running count of 0s in `decisions`
-        self.admitted_total = 0
-        self.shed_total = 0
-
-    @property
-    def effective_weight(self):
-        return self.weight * QOS_MULTIPLIER.get(self.qos, 1.0)
-
-    def record_decision(self, admitted):
-        """Window append with a running shed count — the shed-ratio
-        gauge publishes on every admit/settle under the global lock,
-        so re-counting the window there would be O(window) hot-path
-        work."""
-        if len(self.decisions) == self.decisions.maxlen:
-            self.shed_window -= 1 - self.decisions.popleft()
-        self.decisions.append(1 if admitted else 0)
-        if not admitted:
-            self.shed_window += 1
-
-    def drain_rate(self, now, window_s):
-        horizon = now - window_s
-        while self.completions and self.completions[0] < horizon:
-            self.completions.popleft()
-        if not self.completions:
-            return 0.0
-        return len(self.completions) / window_s
+#: a serving tenant IS a fair-share account (the historical name is
+#: kept: tests and the frontend construct tenants through the
+#: controller, but the class identity is part of the module surface)
+_Tenant = ShareAccount
 
 
 class AdmissionController(Logger):
@@ -229,31 +194,15 @@ class AdmissionController(Logger):
 
     def _share_locked(self, tenant, now):
         """This tenant's guaranteed share (>=1) vs active peers."""
-        active_w = tenant.effective_weight
-        for other in self._tenants.values():
-            if other is tenant:
-                continue
-            if other.outstanding > 0 or \
-                    now - other.last_active <= self.activity_window_s:
-                active_w += other.effective_weight
-        return max(1.0, self.capacity * tenant.effective_weight /
-                   active_w)
+        return guaranteed_share(self.capacity, tenant,
+                                self._tenants.values(), now,
+                                self.activity_window_s)
 
     def _reserved_locked(self, tenant, now):
         """Unused share active OTHER tenants still hold a claim on."""
-        reserved = 0.0
-        total_w = sum(
-            t.effective_weight for t in self._tenants.values()
-            if t is tenant or t.outstanding > 0 or
-            now - t.last_active <= self.activity_window_s)
-        for other in self._tenants.values():
-            if other is tenant:
-                continue
-            if other.outstanding > 0 or \
-                    now - other.last_active <= self.activity_window_s:
-                share = self.capacity * other.effective_weight / total_w
-                reserved += max(0.0, share - other.outstanding)
-        return reserved
+        return reserved_claim(self.capacity, tenant,
+                              self._tenants.values(), now,
+                              self.activity_window_s)
 
     def admit(self, tenant_name=None, n=1, qos=None, now=None):
         """Admit ``n`` samples for the tenant or raise

@@ -233,6 +233,26 @@ DEFAULT_RULES = (
      "description": "an SPMD mesh participant was lost in the last 5 "
                     "minutes; the elastic supervisor re-forms the "
                     "mesh at the surviving world size (ISSUE 13)"},
+    {"name": "job_stuck", "metric": "veles_sched_oldest_pending_s",
+     "agg": "max", "op": ">", "threshold": 300.0, "for_s": 30.0,
+     "clear_for_s": 30.0,
+     "description": "a scheduler job has been runnable (pending or "
+                    "preempted) for over 5 minutes without a grant — "
+                    "the pool is oversubscribed or a gang cannot fit"},
+    {"name": "preempt_storm", "kind": "increase",
+     "metric": "veles_sched_preemptions_total", "window_s": 60.0,
+     "threshold": 5.0, "clear_for_s": 120.0,
+     "description": "6+ preemptions within a minute — tenants are "
+                    "thrashing each other; raise the min-run thrash "
+                    "guard or rebalance tenant weights"},
+    {"name": "tenant_starvation",
+     "metric": "veles_sched_tenant_wait_s", "agg": "max", "op": ">",
+     "threshold": 120.0, "for_s": 30.0, "clear_for_s": 30.0,
+     "severity": "critical",
+     "description": "some tenant's oldest runnable job has waited "
+                    "over 2 minutes while others run — weighted-fair "
+                    "placement is not reaching it (weights, pool "
+                    "size, or a stuck victim gang)"},
 )
 
 

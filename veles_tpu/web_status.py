@@ -49,12 +49,18 @@ th { background: #eee; }
 <a href="/metrics">metrics</a> ·
 <a href="/profile.json">profile</a> ·
 <a href="/cluster.json">cluster</a> ·
-<a href="/alerts.json">alerts</a></p>
+<a href="/alerts.json">alerts</a> ·
+<a href="/jobs.json">jobs</a></p>
 <div id="perf" style="margin-bottom:1em"></div>
 <table id="wf"><thead><tr>
 <th>id</th><th>name</th><th>mode</th><th>master</th><th>uptime</th>
 <th>slaves</th><th>units</th><th>serving</th><th>perf</th>
 <th>stopped</th>
+</tr></thead><tbody></tbody></table>
+<h2 id="jobs-h" style="display:none">scheduled jobs</h2>
+<table id="jobs" style="display:none"><thead><tr>
+<th>id</th><th>name</th><th>tenant</th><th>qos</th><th>state</th>
+<th>world</th><th>preempts</th><th>resume s</th><th>error</th>
 </tr></thead><tbody></tbody></table>
 <script>
 function servingCell(s) {
@@ -159,8 +165,35 @@ async function refresh() {
     tbody.appendChild(tr);
   }
 }
+async function refreshJobs() {
+  try {
+    const resp = await fetch("/jobs.json");
+    const jobs = (await resp.json()).jobs || [];
+    const show = jobs.length ? "" : "none";
+    document.getElementById("jobs-h").style.display = show;
+    document.getElementById("jobs").style.display = show;
+    const tbody = document.querySelector("#jobs tbody");
+    tbody.innerHTML = "";
+    for (const j of jobs) {
+      const tr = document.createElement("tr");
+      if (j.state === "done" || j.state === "failed")
+        tr.className = "dead";
+      for (const v of [j.id, j.name, j.tenant, j.qos, j.state,
+                       j.world, j.preemptions,
+                       j.preempt_resume_s == null ? ""
+                         : j.preempt_resume_s.toFixed(2),
+                       j.error]) {
+        const td = document.createElement("td");
+        td.textContent = v === undefined || v === null ? "" : String(v);
+        tr.appendChild(td);
+      }
+      tbody.appendChild(tr);
+    }
+  } catch (e) {}
+}
 refresh(); setInterval(refresh, 2000);
 refreshPerf(); setInterval(refreshPerf, 5000);
+refreshJobs(); setInterval(refreshJobs, 2000);
 </script></body></html>"""
 
 _SLAVES_PAGE = """<!DOCTYPE html>
@@ -596,6 +629,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(self.server.owner.cluster_report())
         elif self.path.startswith("/alerts.json"):
             self._reply(alerts.get_engine().report())
+        elif self.path.startswith("/jobs.json"):
+            self._reply(self.server.owner.jobs_report())
         elif self.path.startswith("/metrics.json"):
             # cluster-wide: local registry + federated slave series
             self._reply(federation.cluster_snapshot())
@@ -692,7 +727,8 @@ class WebStatusServer(Logger):
         "/", "/status.html", "/logs.html", "/slaves.html",
         "/frontend.html", "/workflow.html", "/timeline.html", "/catalog",
         "/metrics", "/metrics.json", "/profile.json", "/cluster.json",
-        "/alerts.json", "/update", "/service", "/logs", "/events"])
+        "/alerts.json", "/jobs.json", "/update", "/service", "/logs",
+        "/events"])
 
     def count_request(self, path):
         path = path.split("?")[0] or "/"
@@ -730,6 +766,17 @@ class WebStatusServer(Logger):
         if masters:
             report["masters"] = masters
         return report
+
+    def jobs_report(self):
+        """The ``/jobs.json`` body: every pushed scheduler's job
+        table (a ``sched serve --status-url`` push embeds its
+        ``jobs`` list in the periodic ``/update`` blob)."""
+        jobs = []
+        with self._lock:
+            for mid, master in self.masters.items():
+                for job in master.get("jobs") or ():
+                    jobs.append(dict(job, scheduler=mid))
+        return {"jobs": jobs}
 
     def receive_update(self, data):
         """A master's periodic status (``web_status.py:244-251``)."""
