@@ -253,6 +253,54 @@ def _federation_probe(n_series=100, beats=50, rounds=3):
     return {"federation_overhead_ratio": fed / max(base, 1e-9)}
 
 
+def _sched_federation_probe(n_series=200, beats=50, rounds=3):
+    """ISSUE 19 overhead guard (report-only): the elastic-tier twin of
+    :func:`_federation_probe` — heartbeat round-trip against a real
+    :class:`RendezvousServer` with vs. without the SnapshotEncoder
+    delta piggyback, from a 200-series worker registry whose series
+    half-churn every beat. The delta rides the SAME beat the
+    supervisor's liveness verdict depends on, so its encode+absorb
+    cost stays pinned in the baseline."""
+    from veles_tpu.parallel.elastic import (RendezvousClient,
+                                            RendezvousServer)
+    from veles_tpu.telemetry.federation import SnapshotEncoder
+    from veles_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    gauge = reg.gauge("probe_value", labels=("op",))
+    for i in range(n_series):
+        gauge.labels(op="op%d" % i).set(float(i))
+
+    server = RendezvousServer(min_workers=1, settle_s=0.05).start()
+    try:
+        client = RendezvousClient(server.address, "probe-worker")
+        gen = client.join_wait(timeout_s=30.0)["gen"]
+        encoder = SnapshotEncoder(registry=reg)
+        encoder.encode()  # prime: steady-state deltas, not full pushes
+
+        def run_leg(with_telemetry):
+            total = 0.0
+            for i in range(beats):
+                if with_telemetry:
+                    # churn half the series so every delta is honest
+                    for j in range(0, n_series, 2):
+                        gauge.labels(op="op%d" % j).set(float(i + j))
+                t0 = time.perf_counter()
+                telemetry = encoder.encode() if with_telemetry \
+                    else None
+                client.heartbeat_full(gen, telemetry=telemetry)
+                total += time.perf_counter() - t0
+            return total / beats
+
+        run_leg(False)  # warm the path
+        base = min(run_leg(False) for _ in range(rounds))
+        fed = min(run_leg(True) for _ in range(rounds))
+        client.close()
+    finally:
+        server.stop()
+    return {"sched_federation_overhead_ratio": fed / max(base, 1e-9)}
+
+
 def _recovery_probe():
     """ISSUE 12 recovery-time guard (report-only): a loopback
     coordinator pair where one slave takes a job and dies abruptly
@@ -685,6 +733,7 @@ def capture():
     metrics.update(_offload_probe())
     metrics.update(_gspmd_probe())
     metrics.update(_federation_probe())
+    metrics.update(_sched_federation_probe())
     metrics.update(_recovery_probe())
     metrics.update(_spmd_recovery_probe())
     metrics.update(_serving_cache_probe())

@@ -17,6 +17,14 @@ gang. Measured, not guessed:
   curve is BIT-IDENTICAL to the uninterrupted baseline (the ISSUE 18
   acceptance property, a HARD perf-gate metric at exactly 1.0).
 
+The contended leg also pins the ISSUE 19 pane of glass: a
+``SchedulerControl`` endpoint runs next to the scheduler, BOTH
+tenants' live loss must federate onto its ``/metrics`` with
+``{job,tenant}`` labels and land in ``/history.json``, and the
+research job must resume under the SAME trace id it was submitted
+with (the preemption window shows up as a gap in its history —
+``sched_history_gap_s`` in the summary).
+
 Scheduler state changes stream as ``EVENT`` markers on stderr in the
 elastic supervisor's announce format, so a log reader can line this
 bench up with `bench_distributed.py --chaos` output.
@@ -75,6 +83,34 @@ def demo_argv(out, epochs, epoch_sleep=0.0):
     return argv
 
 
+def http_get(port, path):
+    from urllib.request import urlopen
+    url = "http://127.0.0.1:%d%s" % (port, path)
+    with urlopen(url, timeout=5.0) as resp:
+        return resp.read().decode("utf-8")
+
+
+def job_row(port, job_id):
+    for row in json.loads(http_get(port, "/jobs.json"))["jobs"]:
+        if row.get("id") == job_id:
+            return row
+    raise SystemExit("/jobs.json lost job %s" % job_id)
+
+
+def wait_for_live_loss(port, job_id, tenant, timeout_s=240.0):
+    """Block until the scheduler's OWN /metrics shows the job's
+    federated live loss with {job,tenant} labels (ISSUE 19)."""
+    needle = ('veles_sched_job_loss{job="%s",tenant="%s"}'
+              % (job_id, tenant))
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if needle in http_get(port, "/metrics"):
+            announce("sched_live_loss", job=job_id, tenant=tenant)
+            return
+        time.sleep(0.1)
+    raise SystemExit("scheduler /metrics never showed %s" % needle)
+
+
 def wait_for_manifest(snaps, timeout_s=240.0):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
@@ -100,16 +136,25 @@ def run_baseline(out, epochs, epoch_sleep, env):
 
 
 def run_contended(workdir, epochs, epoch_sleep, env):
-    from veles_tpu.sched import DONE, JobSpec, Scheduler
+    from veles_tpu.sched import (DONE, JobSpec, Scheduler,
+                                 SchedulerControl)
 
     snaps = os.path.join(workdir, "snaps")
     research_out = os.path.join(workdir, "research.json")
     prod_out = os.path.join(workdir, "prod.json")
     log_dir = os.path.join(workdir, "logs")
 
+    # fast rollup pushes so the one-pane assertions land well inside
+    # the bench window (the knob only matters when the scheduler set
+    # VELES_SCHED_METRICS_URL, so the baseline leg is untouched)
+    env = dict(env)
+    env["VELES_SCHED_METRICS_S"] = "0.1"
+
     t0 = time.time()
     sched = Scheduler(1, tick_s=0.05, min_run_s=0.5,
                       log_dir=log_dir).start()
+    control = SchedulerControl(sched).start()
+    port = control.address[1]
     try:
         research = sched.submit(JobSpec(
             name="research-train",
@@ -121,15 +166,46 @@ def run_contended(workdir, epochs, epoch_sleep, env):
         # a fresh rebuild: wait for the generation-initial manifest
         wait_for_manifest(snaps)
         announce("sched_checkpoint", job=research.id)
+        # ISSUE 19: the research gang's loss must reach the pane of
+        # glass BEFORE the preemption, so the trace id captured here
+        # can be compared against the resumed job afterwards
+        wait_for_live_loss(port, research.id, "research")
+        trace_before = job_row(port, research.id).get("trace_id")
+        # two epochs + a sleep: prod must still be RUNNING after its
+        # first loss lands, or the live /metrics check has no window
         prod = sched.submit(JobSpec(
-            name="prod-train", argv=demo_argv(prod_out, 1),
+            name="prod-train",
+            argv=demo_argv(prod_out, 2, epoch_sleep=0.4),
             tenant="prod", env=env))
         announce("sched_submit", job=prod.id, tenant="prod",
                  preemptible=False)
+        wait_for_live_loss(port, prod.id, "prod")
         states = sched.wait([research.id, prod.id], timeout_s=600)
+        trace_after = job_row(port, research.id).get("trace_id")
+        history = json.loads(http_get(
+            port, "/history.json?series=veles_sched_job_loss"))
     finally:
+        control.stop()
         sched.stop(kill=True)
     wall = time.time() - t0
+
+    if not trace_before or trace_after != trace_before:
+        raise SystemExit(
+            "research job changed trace id across the preemption: "
+            "%r -> %r" % (trace_before, trace_after))
+    loss_points = {s["labels"].get("job"): s["points"]
+                   for s in history["series"]
+                   if s["name"] == "veles_sched_job_loss"}
+    for jid, tenant in ((research.id, "research"), (prod.id, "prod")):
+        if not loss_points.get(jid):
+            raise SystemExit("no loss history for %s job %s"
+                             % (tenant, jid))
+    # the preemption window must be VISIBLE in the victim's history:
+    # the store never interpolates, so the displacement shows up as
+    # the widest inter-point gap (reported, pinned by test_sched.py)
+    stamps = [p[0] for p in loss_points[research.id]]
+    gap_s = max((b - a for a, b in zip(stamps, stamps[1:])),
+                default=0.0)
 
     if states != {research.id: DONE, prod.id: DONE}:
         tails = []
@@ -149,6 +225,8 @@ def run_contended(workdir, epochs, epoch_sleep, env):
            "prod_preemptions": prod.preemptions,
            "preempt_resume_s": round(research.preempt_resume_s or 0.0,
                                      3),
+           "trace_id": trace_before,
+           "history_gap_s": round(gap_s, 3),
            "research_out": research_out}
     print(json.dumps(row), flush=True)
     return row
@@ -187,6 +265,7 @@ def main():
         "preemptions": contended["preemptions"],
         "sched_preempt_resume_s": contended["preempt_resume_s"],
         "sched_loss_parity": parity,
+        "sched_history_gap_s": contended["history_gap_s"],
     }
     print(json.dumps(summary), flush=True)
     if args.json:

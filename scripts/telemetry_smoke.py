@@ -18,7 +18,13 @@ Drives the REAL surfaces end-to-end, cheaply:
    and a dashboard, scrapes the FEDERATED ``/metrics`` +
    ``/cluster.json`` and asserts per-slave series are present while
    the slaves live and garbage-collected after a clean disconnect
-   (this mode also runs INSTEAD of the default checks).
+   (this mode also runs INSTEAD of the default checks);
+5. with ``--sched`` (ISSUE 19): starts a gang scheduler + 2 one-worker
+   gangs under different tenants, asserts both jobs' live loss lands
+   on the scheduler ``/metrics`` with ``{job,tenant}`` labels and in
+   ``/history.json``, then SIGKILLs one gang and asserts its
+   ``sched_job_failed`` flight record carries the job's trace id
+   (also INSTEAD of the default checks).
 
 Exit code 0 = the exercised surfaces are alive. Runs on CPU in a few
 seconds.
@@ -258,7 +264,102 @@ def check_cluster():
         master.stop()
 
 
+def check_sched():
+    """ISSUE 19: the scheduler is one pane of glass. Two one-worker
+    gangs under different tenants federate their live training series
+    to the scheduler's ``/metrics`` with ``{job,tenant}`` labels and
+    into ``/history.json``; a SIGKILLed gang's ``sched_job_failed``
+    flight record carries the job's trace id."""
+    import signal
+    import tempfile
+    import time
+
+    from veles_tpu.sched import JobSpec, Scheduler, SchedulerControl
+    from veles_tpu.telemetry import flight
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        flight_dir = os.path.join(tmpdir, "flight")
+        # the scheduler's own recorder must land records where this
+        # check can read them (set BEFORE the first dump creates it)
+        os.environ["VELES_FLIGHT_DIR"] = flight_dir
+        worker_env = {k: v for k, v in os.environ.items()
+                      if k != "XLA_FLAGS"}
+        worker_env.update(PYTHONPATH=HERE, JAX_PLATFORMS="cpu",
+                          VELES_FLIGHT_DIR=flight_dir,
+                          VELES_SCHED_METRICS_S="0.2")
+
+        def demo(out):
+            return [sys.executable, "-m",
+                    "veles_tpu.parallel.elastic", "worker-demo",
+                    "--out", out, "--epochs", "60",
+                    "--epoch-sleep", "0.3"]
+
+        sched = Scheduler(2, tick_s=0.05, preempt=False,
+                          log_dir=os.path.join(tmpdir, "logs")).start()
+        control = SchedulerControl(sched).start()
+        base = "http://127.0.0.1:%d" % control.port
+        try:
+            job_a = sched.submit(JobSpec(
+                name="gang-a", argv=demo(os.path.join(tmpdir, "a.json")),
+                tenant="acme", env=worker_env))
+            job_b = sched.submit(JobSpec(
+                name="gang-b", argv=demo(os.path.join(tmpdir, "b.json")),
+                tenant="zeta", env=worker_env))
+            want = {(job_a.id, "acme"), (job_b.id, "zeta")}
+
+            def federated(text):
+                return {(jid, tenant) for jid, tenant in want
+                        if 'veles_sched_job_loss{job="%s",tenant="%s"}'
+                        % (jid, tenant) in text}
+
+            deadline = time.time() + 240
+            while True:
+                text = _get(base, "/metrics")
+                if federated(text) == want:
+                    break
+                assert time.time() < deadline, \
+                    "job series never federated (got %s):\n%s" \
+                    % (federated(text), text[:3000])
+                time.sleep(0.2)
+            hist = json.loads(_get(
+                base, "/history.json?series=veles_sched_job_loss"))
+            with_points = {s["labels"].get("job")
+                           for s in hist["series"] if s["points"]}
+            assert {job_a.id, job_b.id} <= with_points, hist
+            rows = {j["id"]: j for j in
+                    json.loads(_get(base, "/jobs.json"))["jobs"]}
+            assert rows[job_a.id]["metrics"].get("loss") is not None, \
+                rows
+            assert rows[job_b.id]["trace_id"] == job_b.trace_id
+            print("sched federation OK: both gangs' live loss on "
+                  "/metrics with {job,tenant} and in /history.json")
+
+            # SIGKILL gang-b: the reap must leave a sched_job_failed
+            # flight record carrying the job's trace id
+            for proc in job_b.procs:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            sched.wait([job_b.id], timeout_s=60)
+            assert job_b.state == "failed", job_b.state
+            records = [r for r in sorted(os.listdir(flight_dir))
+                       if "sched_job_failed" in r]
+            assert records, os.listdir(flight_dir)
+            record = flight.load_record(
+                os.path.join(flight_dir, records[0]))
+            assert record["context"]["trace_id"] == job_b.trace_id, \
+                record["context"]
+            assert record["context"]["job"]["id"] == job_b.id
+            print("sched flight correlation OK: %s carries trace id %s"
+                  % (records[0], job_b.trace_id))
+        finally:
+            control.stop()
+            sched.stop(kill=True)
+
+
 def main():
+    if "--sched" in sys.argv:
+        check_sched()
+        print("sched observability smoke PASSED")
+        return 0
     if "--cluster" in sys.argv:
         check_cluster()
         print("cluster observability smoke PASSED")

@@ -382,6 +382,91 @@ def test_autoscale_flap_rule_fires_on_transition_churn():
     assert engine.active() == ["autoscale_flap"]
 
 
+# -- ISSUE 19 job-view rules ------------------------------------------------
+
+
+def test_job_loss_plateau_rule_fires_and_clears():
+    """The default rule: one job whose federated loss stopped moving
+    for 10+ minutes fires (agg=max — the stalest job decides); a
+    store with no loss-age gauge at all stays quiet forever."""
+    from veles_tpu.telemetry.alerts import DEFAULT_RULES
+    spec = next(r for r in DEFAULT_RULES
+                if r["name"] == "job_loss_plateau")
+    reg = MetricsRegistry()
+    engine = _engine(reg, spec)
+    t = 1000.0
+    engine.evaluate(now=t)
+    assert engine.active() == []          # gauge absent -> no opinion
+    age = reg.gauge("veles_sched_job_loss_age_s",
+                    labels=("job", "tenant"))
+    age.labels(job="j1", tenant="acme").set(30.0)
+    engine.evaluate(now=t + 1)
+    engine.evaluate(now=t + 40)
+    assert engine.active() == []          # loss moving: healthy
+    # agg=max: ONE plateaued job fires however fresh the others are
+    age.labels(job="j2", tenant="zeta").set(900.0)
+    engine.evaluate(now=t + 41)
+    engine.evaluate(now=t + 75)           # held past for_s=30
+    assert engine.active() == ["job_loss_plateau"]
+    age.labels(job="j2", tenant="zeta").set(1.0)   # loss moved again
+    engine.evaluate(now=t + 80)
+    engine.evaluate(now=t + 115)          # clear held for clear_for_s
+    assert engine.active() == []
+
+
+def test_job_mfu_collapse_rule_min_agg_hysteresis():
+    """agg=min: the WORST job's utilization decides, and a momentary
+    recovery blip must not clear the alert (clear_for_s both ways)."""
+    from veles_tpu.telemetry.alerts import DEFAULT_RULES
+    spec = next(r for r in DEFAULT_RULES
+                if r["name"] == "job_mfu_collapse")
+    reg = MetricsRegistry()
+    engine = _engine(reg, spec)
+    t = 1000.0
+    mfu = reg.gauge("veles_sched_job_mfu", labels=("job", "tenant"))
+    mfu.labels(job="j1", tenant="acme").set(0.45)
+    engine.evaluate(now=t)
+    engine.evaluate(now=t + 70)
+    assert engine.active() == []
+    mfu.labels(job="j2", tenant="zeta").set(0.01)  # one collapsed gang
+    engine.evaluate(now=t + 71)
+    engine.evaluate(now=t + 120)          # 49 s < for_s=60: not yet
+    assert engine.active() == []
+    engine.evaluate(now=t + 135)
+    assert engine.active() == ["job_mfu_collapse"]
+    mfu.labels(job="j2", tenant="zeta").set(0.5)   # momentary blip...
+    engine.evaluate(now=t + 140)
+    assert engine.active() == ["job_mfu_collapse"]
+    engine.evaluate(now=t + 205)          # ...vs a HELD recovery
+    assert engine.active() == []
+
+
+def test_gang_silent_rule_fires_critical_on_beat_age():
+    """The critical rule: a RUNNING gang whose beat-carried telemetry
+    went silent for 30+ s fires within ~10 s of hysteresis, and
+    clears once heartbeat deltas resume."""
+    from veles_tpu.telemetry.alerts import DEFAULT_RULES
+    spec = next(r for r in DEFAULT_RULES if r["name"] == "gang_silent")
+    assert spec["severity"] == "critical"
+    reg = MetricsRegistry()
+    engine = _engine(reg, spec)
+    t = 1000.0
+    beat = reg.gauge("veles_sched_beat_age_s",
+                     labels=("job", "tenant"))
+    beat.labels(job="j1", tenant="acme").set(0.5)
+    engine.evaluate(now=t)
+    assert engine.active() == []
+    beat.labels(job="j1", tenant="acme").set(45.0)  # gang went dark
+    engine.evaluate(now=t + 1)
+    engine.evaluate(now=t + 12)           # held past for_s=10
+    assert engine.active() == ["gang_silent"]
+    assert _active(reg, "gang_silent") == 1.0
+    beat.labels(job="j1", tenant="acme").set(0.2)   # beats resumed
+    engine.evaluate(now=t + 13)
+    engine.evaluate(now=t + 24)           # clear held for 11 s
+    assert engine.active() == []
+
+
 def test_tenant_shed_burn_rule_fires_per_tenant():
     from veles_tpu.telemetry.alerts import DEFAULT_RULES
     spec = next(r for r in DEFAULT_RULES

@@ -383,9 +383,157 @@ def test_web_status_renders_pushed_jobs():
 def test_sched_alert_rules_are_wired():
     from veles_tpu.telemetry.alerts import DEFAULT_RULES, AlertEngine
     names = {rule["name"] for rule in DEFAULT_RULES}
-    assert {"job_stuck", "preempt_storm",
-            "tenant_starvation"} <= names
+    assert {"job_stuck", "preempt_storm", "tenant_starvation",
+            "job_loss_plateau", "job_mfu_collapse",
+            "gang_silent"} <= names
     AlertEngine()   # every rule must construct against the registry
+
+
+# -- ISSUE 19: the one pane of glass -----------------------------------------
+
+
+def _worker_delta(**gauges):
+    """One rank-0 push: what the gang's _MetricsPusher would POST."""
+    from veles_tpu.telemetry.federation import SnapshotEncoder
+    from veles_tpu.telemetry.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    for name, value in gauges.items():
+        reg.gauge("veles_" + name).set(value)
+    return SnapshotEncoder(registry=reg).encode()
+
+
+def _loss_points(job_id):
+    from veles_tpu.telemetry.timeseries import get_history
+    reply = get_history().query(series="veles_sched_job_loss")
+    for entry in reply["series"]:
+        if entry["labels"].get("job") == job_id:
+            return entry["points"]
+    return []
+
+
+def test_scheduler_federates_job_telemetry_one_pane():
+    """A gang's pushed registry delta surfaces on the scheduler's OWN
+    cluster snapshot — mirror gauges and raw worker series both carry
+    {job,tenant}, /jobs.json rows grow live metrics + the trace id,
+    and the history store gets the loss point. Terminal jobs drop
+    their whole view."""
+    from veles_tpu.telemetry.registry import render_snapshot
+    sched = Scheduler(1, preempt=False)
+    job = sched.submit(JobSpec(argv=SLEEP, tenant="acme"))
+    sched.tick()
+    assert job.state == RUNNING
+    hints = sched.absorb_telemetry(job.id, _worker_delta(
+        train_loss=0.42, train_samples_per_s=100.0, step_mfu=0.71))
+    assert hints == {}
+    sched.tick()
+    row = {j["id"]: j for j in sched.jobs_report()["jobs"]}[job.id]
+    assert row["trace_id"] == job.trace_id
+    assert row["metrics"]["loss"] == 0.42
+    assert row["metrics"]["mfu"] == 0.71
+    assert row["metrics"]["beat_age_s"] >= 0.0
+    text = render_snapshot(sched.cluster_snapshot())
+    assert ('veles_sched_job_loss{job="%s",tenant="acme"} 0.42'
+            % job.id) in text
+    assert ('veles_train_loss{job="%s",tenant="acme"} 0.42'
+            % job.id) in text
+    assert _loss_points(job.id), "history missed the loss point"
+    # a push for an unknown job is absorbed without a crash and
+    # without minting a view
+    assert sched.absorb_telemetry("job-nope", _worker_delta(
+        train_loss=1.0)) is not None
+    sched.stop(kill=True)
+    # FAILED via stop: the job's federated feed and mirror gauges GC
+    text = render_snapshot(sched.cluster_snapshot())
+    assert ('job="%s"' % job.id) not in text
+
+
+def test_queue_wait_and_share_fraction_metrics():
+    from veles_tpu.telemetry.registry import get_registry
+    sched = Scheduler(2, preempt=False)
+    a = sched.submit(JobSpec(argv=SLEEP, tenant="acme"))
+    b = sched.submit(JobSpec(argv=SLEEP, tenant="zeta"))
+    sched.tick()
+    assert a.state == RUNNING and b.state == RUNNING
+    # submit -> FIRST placement wait, pinned on the job and observed
+    # into the histogram
+    assert a.queue_wait_s is not None and a.queue_wait_s >= 0.0
+    rows = {j["id"]: j for j in sched.jobs_report()["jobs"]}
+    assert rows[a.id]["queue_wait_s"] == a.queue_wait_s
+    snap = get_registry().snapshot()
+    wait = snap["histograms"]["veles_sched_queue_wait_s"]
+    assert sum(s["count"] for s in wait["series"]) >= 2
+    stats = sched.stats()
+    shares = {tenant: row["share_fraction"]
+              for tenant, row in stats["tenants"].items()}
+    assert set(shares) == {"acme", "zeta"}
+    assert shares["acme"] == shares["zeta"]      # equal weights
+    assert 0.0 < shares["acme"] <= 1.0
+    assert sum(shares.values()) <= 1.0 + 1e-9
+    from veles_tpu.telemetry.registry import render_snapshot
+    text = render_snapshot(sched.cluster_snapshot())
+    assert 'veles_sched_share_fraction{tenant="acme"}' in text
+    sched.stop(kill=True)
+
+
+def test_preempt_resume_same_trace_id_and_history_gap(tmp_path):
+    """The ISSUE 19 acceptance pin: a preempted job resumes under the
+    SAME trace id (every generation's env carries it), its queue-wait
+    stays the FIRST-placement value, and the displacement window is a
+    visible hole in its loss history — never a bridged line."""
+    marker = (
+        "import os, time; open(%r + '/trace-' +"
+        " os.environ['VELES_ELASTIC_GEN'], 'w')"
+        ".write(os.environ['VELES_ELASTIC_TRACE'] + ':' +"
+        " os.environ['VELES_ELASTIC_JOB'] + ':' +"
+        " os.environ['VELES_ELASTIC_TENANT']); time.sleep(30)"
+        % str(tmp_path))
+    sched = Scheduler(1, min_run_s=0.1)
+    victim = sched.submit(JobSpec(
+        argv=[sys.executable, "-c", marker], tenant="research",
+        snapshot_dir=str(tmp_path / "snaps")))
+    sched.tick()
+    assert victim.state == RUNNING
+    first_wait = victim.queue_wait_s
+    assert first_wait is not None
+    sched.absorb_telemetry(victim.id, _worker_delta(train_loss=0.9))
+    sched.tick()                    # the pre-preemption history point
+    before = _loss_points(victim.id)
+    assert before
+    time.sleep(0.15)                # past the thrash guard
+    claimant = sched.submit(JobSpec(
+        argv=[sys.executable, "-c", "import time; time.sleep(0.8)"],
+        tenant="prod"))
+    sched.tick()
+    assert victim.state == PREEMPTED and claimant.state == RUNNING
+    # displaced: ticks during the window add NO points for the victim
+    time.sleep(0.7)
+    sched.tick()
+    assert _loss_points(victim.id) == before
+    _tick_until(sched, lambda: victim.state == RUNNING, timeout_s=30)
+    sched.absorb_telemetry(victim.id, _worker_delta(train_loss=0.8))
+    sched.tick()
+    after = _loss_points(victim.id)
+    assert len(after) > len(before)
+    gap = after[len(before)][0] - before[-1][0]
+    assert gap >= 0.7, "preemption window was bridged: gap=%.3fs" % gap
+    assert victim.queue_wait_s == first_wait   # resumes excluded
+    assert victim.grants == 2
+
+    def _trace_files():
+        return sorted(f for f in os.listdir(str(tmp_path))
+                      if f.startswith("trace-"))
+
+    # give the resumed generation a beat to write its env marker
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and len(_trace_files()) < 2:
+        time.sleep(0.05)
+    sched.stop(kill=True)
+    trace_files = _trace_files()
+    assert len(trace_files) == 2    # one per generation
+    contents = {open(os.path.join(str(tmp_path), f)).read()
+                for f in trace_files}
+    assert contents == {"%s:%s:research"
+                        % (victim.trace_id, victim.id)}
 
 
 # -- the atexit regression (satellite 1) -------------------------------------
@@ -509,6 +657,56 @@ def test_preempt_resume_loss_parity(tmp_path):
     rows = {j["id"]: j for j in sched.jobs_report()["jobs"]}
     assert rows[research.id]["preemptions"] == research.preemptions
     assert rows[prod.id]["state"] == DONE
+
+
+def test_failed_gang_leaves_trace_correlated_flight_chain(
+        tmp_path, monkeypatch):
+    """ISSUE 19 acceptance: a gang dying mid-epoch leaves ONE
+    correlated incident — the worker's ``elastic_worker_failed``
+    record (written on disk by the dying subprocess) and the
+    scheduler's ``sched_job_failed`` dump share the job's trace id,
+    so an operator can walk the whole chain from either end."""
+    from veles_tpu.telemetry import flight
+    dumps = []
+
+    class _Recorder(object):
+        def dump(self, reason, **context):
+            dumps.append((reason, context))
+
+    monkeypatch.setattr(flight, "get_recorder", lambda: _Recorder())
+    flight_dir = str(tmp_path / "flight")
+    worker_env = _subprocess_env({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "VELES_FLIGHT_DIR": flight_dir,
+        "VELES_ELASTIC_TEST_FAIL": "0:1"})   # rank 0 raises at epoch 1
+    out = str(tmp_path / "out.json")
+    sched = Scheduler(1, tick_s=0.05, preempt=False,
+                      log_dir=str(tmp_path / "logs")).start()
+    try:
+        job = sched.submit(JobSpec(
+            name="doomed", argv=_demo_argv(out, epochs=4),
+            tenant="acme", env=worker_env,
+            snapshot_dir=str(tmp_path / "snaps")))
+        states = sched.wait([job.id], timeout_s=480)
+    finally:
+        sched.stop(kill=True)
+    assert states == {job.id: FAILED}
+    assert job.trace_id
+    # the scheduler's link in the chain
+    by_reason = {reason: context for reason, context in dumps}
+    assert by_reason["sched_job_failed"]["trace_id"] == job.trace_id
+    assert by_reason["sched_job_failed"]["job"]["id"] == job.id
+    # the worker's link, written by the dying subprocess
+    records = [flight.load_record(os.path.join(flight_dir, name))
+               for name in sorted(os.listdir(flight_dir))]
+    worker = [r for r in records
+              if r["reason"] == "elastic_worker_failed"]
+    assert worker, [r["reason"] for r in records]
+    context = worker[-1]["context"]
+    assert context["trace_id"] == job.trace_id
+    assert context["job"] == job.id
+    assert "induced worker failure" in context["error"]
 
 
 # -- acceptance e2e: scheduled genetics == serial genetics -------------------

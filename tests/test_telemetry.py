@@ -268,6 +268,44 @@ def test_webstatus_metrics_endpoints():
         server.stop()
 
 
+def test_webstatus_history_endpoint():
+    """/history.json (ISSUE 19): prefix + since-cursor query over the
+    global store, and a malformed cursor is a 400, not a stack trace."""
+    import urllib.error
+    import urllib.request
+    from veles_tpu.telemetry.timeseries import get_history
+    from veles_tpu.web_status import WebStatusServer
+
+    history = get_history()
+    history.record("veles_test_hist_g", {"k": "a"}, 1.0, now=100.0)
+    history.record("veles_test_hist_g", {"k": "a"}, 2.0, now=101.0)
+    server = WebStatusServer(host="127.0.0.1", port=0).start()
+    try:
+        base = "http://127.0.0.1:%d" % server.port
+        with urllib.request.urlopen(
+                base + "/history.json?series=veles_test_hist_",
+                timeout=5) as resp:
+            reply = json.loads(resp.read())
+        (entry,) = reply["series"]
+        assert entry["name"] == "veles_test_hist_g"
+        assert entry["labels"] == {"k": "a"}
+        assert [[100.0, 1.0], [101.0, 2.0]] == entry["points"]
+        with urllib.request.urlopen(
+                base + "/history.json?series=veles_test_hist_&since=100.5",
+                timeout=5) as resp:
+            delta = json.loads(resp.read())
+        assert [[101.0, 2.0]] == delta["series"][0]["points"]
+        try:
+            urllib.request.urlopen(
+                base + "/history.json?since=nonsense", timeout=5)
+            assert False, "malformed cursor must 400"
+        except urllib.error.HTTPError as err:
+            assert err.code == 400
+    finally:
+        server.stop()
+        history.drop("veles_test_hist_g")
+
+
 # -- coordinator propagation ------------------------------------------------
 
 

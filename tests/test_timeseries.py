@@ -1,0 +1,205 @@
+"""Bounded metric history (ISSUE 19): the ``/history.json`` store's
+resolution / downsample / retention invariants, since-cursor
+pagination, preemption-gap visibility, and flood-bounded memory."""
+
+import time
+
+import pytest
+
+from veles_tpu.telemetry.registry import MetricsRegistry
+from veles_tpu.telemetry.timeseries import SeriesStore
+
+
+def _store(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("resolution_s", 0.5)
+    kw.setdefault("max_points", 512)
+    kw.setdefault("retention_s", 3600.0)
+    kw.setdefault("max_series", 1024)
+    return SeriesStore(**kw)
+
+
+def _points(store, name, **labels):
+    for entry in store.query(series=name)["series"]:
+        if entry["name"] == name and entry["labels"] == labels:
+            return entry["points"]
+    return None
+
+
+# -- ring invariants --------------------------------------------------------
+
+
+def test_same_bucket_overwrites_last_writer_wins():
+    store = _store(resolution_s=1.0)
+    store.record("m", {}, 1.0, now=100.2)
+    store.record("m", {}, 2.0, now=100.7)    # same 1 s bucket
+    store.record("m", {}, 3.0, now=101.1)    # next bucket
+    assert _points(store, "m") == [[100.2, 2.0], [101.1, 3.0]]
+
+
+def test_out_of_order_point_dropped_never_sorted():
+    store = _store()
+    store.record("m", {}, 1.0, now=100.0)
+    store.record("m", {}, 9.0, now=50.0)
+    assert _points(store, "m") == [[100.0, 1.0]]
+
+
+def test_downsample_on_overflow_doubles_resolution():
+    store = _store(resolution_s=1.0, max_points=8)
+    for i in range(9):
+        store.record("m", {}, float(i), now=100.0 + i)
+    pts = _points(store, "m")
+    # halved density, resolution doubled, the NEWEST point kept
+    # exactly (it anchors "now"), time still strictly ascending
+    assert pts == [[100.0 + i, float(i)] for i in (0, 2, 4, 6, 8)]
+    (entry,) = store.query(series="m")["series"]
+    assert entry["res_s"] == 2.0
+
+
+def test_flood_10k_points_stays_bounded():
+    store = _store(resolution_s=0.5, max_points=64)
+    for i in range(10000):
+        store.record("m", {}, float(i), now=100.0 + i)
+    pts = _points(store, "m")
+    assert len(pts) <= 64
+    assert pts[-1][1] == 9999.0              # newest survives exactly
+    assert pts == sorted(pts)
+
+
+def test_retention_prunes_old_points():
+    store = _store(retention_s=10.0)
+    store.record("m", {}, 1.0, now=100.0)
+    store.record("m", {}, 2.0, now=105.0)
+    store.record("m", {}, 3.0, now=112.0)    # horizon moves to 102
+    assert _points(store, "m") == [[105.0, 2.0], [112.0, 3.0]]
+
+
+def test_max_series_cap_counts_drops_keeps_existing():
+    reg = MetricsRegistry()
+    store = _store(registry=reg, max_series=2)
+    assert store.record("a", {}, 1.0, now=100.0)
+    assert store.record("b", {}, 1.0, now=100.0)
+    assert not store.record("c", {}, 1.0, now=100.0)
+    # an EXISTING series keeps accepting points at the cap
+    assert store.record("a", {}, 2.0, now=101.0)
+    assert store.series_count() == 2
+    snap = reg.snapshot()
+    dropped = snap["counters"]["veles_history_dropped_series_total"]
+    assert dropped["series"][0]["value"] == 1.0
+    held = snap["gauges"]["veles_history_series"]
+    assert held["series"][0]["value"] == 2.0
+
+
+# -- query surface ----------------------------------------------------------
+
+
+def test_since_cursor_returns_strict_delta():
+    store = _store()
+    store.record("m", {"job": "j"}, 1.0, now=100.0)
+    first = store.query(series="m", now=100.5)
+    store.record("m", {"job": "j"}, 2.0, now=101.0)
+    delta = store.query(series="m", since=first["now"], now=101.5)
+    (entry,) = delta["series"]
+    assert entry["points"] == [[101.0, 2.0]]
+    # strictly newer: a point AT the cursor is never re-sent
+    again = store.query(series="m", since=101.0)
+    assert again["series"][0]["points"] == []
+
+
+def test_bad_since_cursor_raises_for_http_400():
+    store = _store()
+    with pytest.raises(ValueError):
+        store.query(since="nope")
+
+
+def test_query_prefix_filter_and_drop():
+    store = _store()
+    store.record("veles_sched_job_loss", {"job": "a"}, 1.0, now=100.0)
+    store.record("veles_sched_job_mfu", {"job": "a"}, 0.4, now=100.0)
+    store.record("other", {}, 9.0, now=100.0)
+    got = store.query(series="veles_sched_job_")
+    assert {s["name"] for s in got["series"]} == {
+        "veles_sched_job_loss", "veles_sched_job_mfu"}
+    store.drop("other")
+    assert store.series_count() == 2
+
+
+def test_preemption_gap_stays_visible_no_interpolation():
+    store = _store()
+    for i in range(4):
+        store.record("loss", {"job": "j"}, 1.0 - i * 0.1,
+                     now=100.0 + i)
+    # ... 27 s displaced by a preemption: NOTHING is recorded ...
+    for i in range(4):
+        store.record("loss", {"job": "j"}, 0.6 - i * 0.1,
+                     now=130.0 + i)
+    pts = _points(store, "loss", job="j")
+    assert len(pts) == 8                     # no synthetic fill
+    stamps = [p[0] for p in pts]
+    assert max(b - a for a, b in zip(stamps, stamps[1:])) >= 27.0
+
+
+# -- snapshot ingest + pump -------------------------------------------------
+
+
+def test_ingest_takes_gauges_and_counters_not_histograms():
+    reg = MetricsRegistry()
+    reg.gauge("g", labels=("job",)).labels(job="j").set(5.0)
+    reg.counter("c").inc(3)
+    reg.histogram("h").observe(1.0)
+    store = _store()
+    store.ingest(reg.snapshot(), now=100.0)
+    assert {s["name"] for s in store.query()["series"]} == {"g", "c"}
+    assert _points(store, "g", job="j") == [[100.0, 5.0]]
+
+
+def test_ingest_excludes_own_meta_families():
+    store = _store()
+    reg = MetricsRegistry()
+    reg.gauge("veles_history_series").set(3.0)
+    store.ingest(reg.snapshot(), now=100.0)
+    assert store.query()["series"] == []
+
+
+def test_ingest_excludes_gap_aware_sched_mirrors():
+    """The snapshot pump must never re-ingest the per-job mirror
+    gauges: the scheduler records those itself (RUNNING gangs only),
+    and a pump reading the stale gauge of a PREEMPTED job would
+    bridge the preemption hole. Direct record() still works — that
+    IS the scheduler's path."""
+    store = _store()
+    reg = MetricsRegistry()
+    reg.gauge("veles_sched_job_loss", labels=("job", "tenant")).labels(
+        job="j1", tenant="acme").set(0.5)
+    store.ingest(reg.snapshot(), now=100.0)
+    assert store.query()["series"] == []
+    assert store.record("veles_sched_job_loss",
+                        {"job": "j1", "tenant": "acme"}, 0.5, now=100.0)
+    assert _points(store, "veles_sched_job_loss",
+                   job="j1", tenant="acme") == [[100.0, 0.5]]
+
+
+def test_pump_ingests_registry_snapshots():
+    reg = MetricsRegistry()
+    reg.gauge("g").set(1.0)
+    store = _store(registry=reg)
+    store.start(interval_s=0.05, registry=reg)
+    try:
+        deadline = time.time() + 10.0
+        while time.time() < deadline and not _points(store, "g"):
+            time.sleep(0.05)
+    finally:
+        store.stop()
+    assert _points(store, "g")
+
+
+def test_knobs_read_from_env(monkeypatch):
+    monkeypatch.setenv("VELES_HISTORY_POINTS", "16")
+    monkeypatch.setenv("VELES_HISTORY_RESOLUTION_S", "2.0")
+    monkeypatch.setenv("VELES_HISTORY_RETENTION_S", "60")
+    monkeypatch.setenv("VELES_HISTORY_MAX_SERIES", "4")
+    store = SeriesStore(registry=MetricsRegistry())
+    assert store.max_points == 16
+    assert store.resolution_s == 2.0
+    assert store.retention_s == 60.0
+    assert store.max_series == 4

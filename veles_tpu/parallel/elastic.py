@@ -68,7 +68,7 @@ import sys
 import threading
 import time
 
-from veles_tpu.envknob import env_knob
+from veles_tpu.envknob import env_flag, env_knob
 from veles_tpu.logger import Logger
 from veles_tpu.parallel.retry import retry_with_backoff
 
@@ -78,10 +78,21 @@ ENV_WORLD = "VELES_ELASTIC_WORLD"
 ENV_RANK = "VELES_ELASTIC_RANK"
 ENV_COORD = "VELES_ELASTIC_COORD"
 ENV_SNAPSHOTS = "VELES_ELASTIC_SNAPSHOTS"
+#: job identity (ISSUE 19): the scheduler mints ONE trace id per job
+#: and carries it here, so worker spans, flight records from a dying
+#: gang and preempt/resume events all correlate under the job's id
+ENV_TRACE = "VELES_ELASTIC_TRACE"
+ENV_JOB = "VELES_ELASTIC_JOB"
+ENV_TENANT = "VELES_ELASTIC_TENANT"
 #: test/chaos hook: ``"<rank>:<epochs_done>"`` — the matching worker
 #: SIGKILLs itself at that epoch boundary BEFORE the checkpoint is cut
 #: (the deterministic mid-epoch death, like PR 12's death-on-job-8)
 ENV_TEST_DIE = "VELES_ELASTIC_TEST_DIE"
+#: like ENV_TEST_DIE but the worker RAISES instead of SIGKILLing
+#: itself — the death leaves a flight record behind, which the trace-
+#: correlation tests read back (a SIGKILL leaves only the scheduler's
+#: own record)
+ENV_TEST_FAIL = "VELES_ELASTIC_TEST_FAIL"
 
 
 def _metrics():
@@ -162,6 +173,12 @@ class RendezvousServer(Logger):
         self.lost_total = 0
         self.last_recovery_s = None
         self._metrics = _metrics()
+        #: federated member telemetry (ISSUE 19): heartbeats carry
+        #: SnapshotEncoder deltas, absorbed here with the SAME
+        #: resync/GC/cardinality semantics as the coordinator path —
+        #: created on the first beat that actually carries telemetry
+        self._federation = None
+        self._federation_lock = threading.Lock()
         self._stop = threading.Event()
         self._conns = set()
         self._listener = socket.socket()
@@ -233,6 +250,16 @@ class RendezvousServer(Logger):
                     break
                 member = msg.get("member", member)
                 reply = self._handle(msg)
+                telemetry = msg.get("telemetry")
+                if telemetry is not None and member is not None:
+                    # absorbed OUTSIDE self._lock (the coordinator's
+                    # _absorb_telemetry pattern): merging a delta must
+                    # not serialize against membership dispatch
+                    try:
+                        reply.update(
+                            self._absorb_telemetry(member, telemetry))
+                    except Exception:
+                        pass  # telemetry must never kill the beat
                 with self._lock:
                     # this conn is now the member's CURRENT lifeline
                     state = self._members.get(member)
@@ -264,6 +291,33 @@ class RendezvousServer(Logger):
                 # backstop). Never on server stop(): that close is
                 # ours, not a death.
                 self._remove_member(member, reason="connection_lost")
+
+    # -- federated telemetry -----------------------------------------------
+
+    def federation(self):
+        """The server's :class:`FederatedRegistry` (created on first
+        use — a pod that never piggybacks telemetry never pays for
+        one)."""
+        with self._federation_lock:
+            if self._federation is None:
+                from veles_tpu.telemetry.federation import \
+                    FederatedRegistry
+                self._federation = FederatedRegistry()
+            return self._federation
+
+    def _absorb_telemetry(self, member, delta):
+        """Merge one beat-carried delta; returns ack hints for the
+        reply (``{"resync": True}`` after a sequence gap)."""
+        hints = self.federation().apply(member, delta)
+        with self._lock:
+            live = member in self._members
+        if not live:
+            # reaped between dispatch and merge: the feed must not
+            # outlive the membership (same liveness re-check the
+            # coordinator does after its out-of-lock merge)
+            self._federation.remove_slave(member)
+            return {}
+        return hints or {}
 
     # -- protocol ----------------------------------------------------------
 
@@ -378,6 +432,11 @@ class RendezvousServer(Logger):
                       if in_current else "")
             if in_current:
                 self._break_generation("%s(%s)" % (reason, member))
+        with self._federation_lock:
+            federation = self._federation
+        if federation is not None:
+            # GC the dead member's federated feed with the membership
+            federation.remove_slave(member)
 
     def _break_generation(self, reason, lost=True):
         """A participant of the RUNNING generation is gone (or a join
@@ -539,7 +598,16 @@ class RendezvousClient(object):
             time.sleep(poll_s)
 
     def heartbeat(self, gen):
-        return self._request({"cmd": "hb", "gen": gen}).get("status")
+        return self.heartbeat_full(gen).get("status")
+
+    def heartbeat_full(self, gen, telemetry=None):
+        """Full heartbeat reply dict; ``telemetry`` (a SnapshotEncoder
+        delta) piggybacks on the beat — the reply may carry a
+        ``resync`` hint the caller must feed back to its encoder."""
+        msg = {"cmd": "hb", "gen": gen}
+        if telemetry is not None:
+            msg["telemetry"] = telemetry
+        return self._request(msg)
 
     def set_coord(self, gen, addr):
         self._request({"cmd": "set_coord", "gen": gen, "addr": addr})
@@ -615,6 +683,20 @@ class ElasticSupervisor(Logger):
         self.generation = None
         self._metrics = _metrics()
         self._detect_t = None
+        # ISSUE 19: the job trace id rides VELES_ELASTIC_TRACE from
+        # the scheduler through this supervisor into the worker env
+        # (os.environ is copied into every spawn) — our own spans and
+        # flight records correlate under it too
+        self.trace_id = env_knob(ENV_TRACE)
+        if self.trace_id:
+            from veles_tpu.telemetry import tracing
+            tracing.set_default_trace_id(self.trace_id)
+        # heartbeat-piggybacked telemetry (same flag as the
+        # coordinator tier: VELES_FEDERATION=0 turns it off fleet-wide)
+        self._encoder = None
+        if env_flag("VELES_FEDERATION", True):
+            from veles_tpu.telemetry.federation import SnapshotEncoder
+            self._encoder = SnapshotEncoder()
 
     def _announce(self, name, **fields):
         if not self.announce:
@@ -737,6 +819,17 @@ class ElasticSupervisor(Logger):
                              gen, code, crashes, self.max_restarts)
                 self._announce("spmd_worker_died", gen=gen, code=code,
                                crashes=crashes)
+                try:
+                    # the supervisor's link in the correlated flight
+                    # chain: worker record -> THIS -> the scheduler's
+                    # sched_job_failed, all under the job's trace id
+                    from veles_tpu.telemetry import flight
+                    flight.get_recorder().dump(
+                        "elastic_worker_died", gen=gen, rank=rank,
+                        code=code, member=self.member,
+                        crashes=crashes, trace_id=self.trace_id)
+                except Exception:
+                    pass  # the black box must never kill recovery
                 if crashes > self.max_restarts:
                     self.error("crash budget exhausted — leaving the "
                                "pod")
@@ -752,11 +845,22 @@ class ElasticSupervisor(Logger):
     def _watch(self, client, gen):
         """Poll worker + rendezvous until one of them moves. Returns
         ``"exited"`` (local worker ended), ``"restart"`` (the
-        generation broke elsewhere) or ``"done"``."""
+        generation broke elsewhere) or ``"done"``. Every beat carries
+        this process's metric delta for the rendezvous anchor's
+        federated view; encoding failures never break the beat."""
         while True:
             if self.worker.poll() is not None:
                 return "exited"
-            status = client.heartbeat(gen)
+            telemetry = None
+            if self._encoder is not None:
+                try:
+                    telemetry = self._encoder.encode()
+                except Exception:
+                    telemetry = None
+            reply = client.heartbeat_full(gen, telemetry=telemetry)
+            if reply.get("resync") and self._encoder is not None:
+                self._encoder.mark_resync()
+            status = reply.get("status")
             if status == "restart":
                 return "restart"
             if status == "done":
@@ -828,6 +932,90 @@ def _test_die_hook(ctx, trainer):
         os.kill(os.getpid(), signal.SIGKILL)
 
 
+def _test_fail_hook(ctx, trainer):
+    spec = env_knob(ENV_TEST_FAIL)
+    if not spec or ctx is None:
+        return
+    rank, _, epochs = spec.partition(":")
+    if int(rank) == ctx.rank and \
+            int(epochs) == len(trainer.decision.epoch_history):
+        # the RAISING twin of _test_die_hook: the worker dies through
+        # the exception path, so its flight record (carrying the job
+        # trace id) exists for the correlation tests to read back
+        raise RuntimeError(
+            "induced worker failure (%s=%s)" % (ENV_TEST_FAIL, spec))
+
+
+class _MetricsPusher(object):
+    """Rank 0's scheduler rollup feed (ISSUE 19): delta-encode the
+    local registry and POST it to the scheduler's loopback control
+    endpoint (``VELES_SCHED_METRICS_URL``, set by the scheduler in
+    the gang env) every ``VELES_SCHED_METRICS_S`` seconds. Every
+    failure is swallowed — the scheduler being down must never stall
+    or kill training."""
+
+    def __init__(self, url, job, interval_s):
+        from veles_tpu.telemetry.federation import SnapshotEncoder
+        self.url = url
+        self.job = job
+        self.interval_s = interval_s
+        self._encoder = SnapshotEncoder()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="sched-metrics-push")
+        self._thread.start()
+
+    def _push(self):
+        import urllib.request
+        delta = self._encoder.encode()
+        if delta is None:
+            return
+        body = json.dumps({"job": self.job,
+                           "telemetry": delta}).encode("utf-8")
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            reply = json.loads(resp.read().decode("utf-8"))
+        if reply.get("resync"):
+            self._encoder.mark_resync()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._push()
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            # one final flush so the last epoch's loss reaches the
+            # scheduler even when the job exits between intervals
+            self._push()
+        except Exception:
+            pass
+        self._thread.join(timeout=5)
+
+
+def _start_metrics_pusher(ctx):
+    """The pusher when this process should feed the scheduler: a
+    ``VELES_SCHED_METRICS_URL`` is present and this is the gang's
+    rank 0 (or an unsupervised standalone run)."""
+    url = env_knob("VELES_SCHED_METRICS_URL")
+    if not url or (ctx is not None and ctx.rank != 0):
+        return None
+    if not env_flag("VELES_FEDERATION", True):
+        return None
+    interval_s = env_knob("VELES_SCHED_METRICS_S", 0.5, parse=float,
+                          on_error="default")
+    job = env_knob(ENV_JOB, "")
+    try:
+        return _MetricsPusher(url, job, interval_s)
+    except Exception:
+        return None
+
+
 def save_elastic_checkpoint(trainer, ctx, params, states):
     """Cut one sharded checkpoint generation at a complete step
     boundary: every process writes its own shards, a cross-process
@@ -876,8 +1064,43 @@ def run_elastic_training(build_workflow, device=None, mesh=None,
     import logging
     log = logging.getLogger("elastic")
     ctx = worker_context()
+    trace_id = env_knob(ENV_TRACE)
+    if trace_id:
+        from veles_tpu.telemetry import tracing
+        tracing.set_default_trace_id(trace_id)
     if ctx is not None:
         init_distributed(ctx)
+    pusher = _start_metrics_pusher(ctx)
+    try:
+        return _run_elastic_training(
+            log, ctx, build_workflow, device=device, mesh=mesh,
+            trainer_cls=trainer_cls, trainer_kwargs=trainer_kwargs,
+            on_epoch=on_epoch, max_epochs=max_epochs)
+    except Exception as e:
+        try:
+            # the worker's link in the correlated flight chain: its
+            # record names the generation/rank AND the job trace id,
+            # so an operator can walk worker -> supervisor ->
+            # scheduler records of one incident
+            from veles_tpu.telemetry import flight
+            flight.get_recorder().dump(
+                "elastic_worker_failed",
+                error="%s: %s" % (type(e).__name__, e),
+                generation=ctx.generation if ctx else None,
+                rank=ctx.rank if ctx else None,
+                job=env_knob(ENV_JOB), trace_id=trace_id)
+        except Exception:
+            pass
+        raise
+    finally:
+        if pusher is not None:
+            pusher.stop()
+
+
+def _run_elastic_training(log, ctx, build_workflow, device=None,
+                          mesh=None, trainer_cls=None,
+                          trainer_kwargs=None, on_epoch=None,
+                          max_epochs=None):
     snapdir = ctx.snapshot_dir if ctx is not None else None
     workflow = None
     if snapdir:
@@ -922,6 +1145,7 @@ def run_elastic_training(build_workflow, device=None, mesh=None,
         def epoch_callback(tr, params, states):
             if on_epoch is not None:
                 on_epoch(tr, params, states)
+            _test_fail_hook(ctx, tr)
             _test_die_hook(ctx, tr)
             save_elastic_checkpoint(tr, ctx, params, states)
 

@@ -22,6 +22,7 @@ into ``veles_sched_jobs_total``.
 import itertools
 import sys
 import time
+import uuid
 
 from veles_tpu.fairshare import DEFAULT_QOS, QOS_MULTIPLIER
 
@@ -82,6 +83,38 @@ def _metrics():
             "veles_sched_tenant_wait_s",
             "Oldest runnable-job wait per tenant (feeds "
             "tenant_starvation)", labels=("tenant",)),
+        "queue_wait": r.histogram(
+            "veles_sched_queue_wait_s",
+            "Submit -> FIRST placement wait (resumes excluded)"),
+        "share_fraction": r.gauge(
+            "veles_sched_share_fraction",
+            "Guaranteed fair share as a fraction of the pool per "
+            "tenant (the ledger's decision, not its outcome)",
+            labels=("tenant",)),
+        # the federated job view: each gang's rank-0 pushes its
+        # registry delta to the scheduler; these mirror the live
+        # training signal under {job,tenant} so alert rules and the
+        # cluster /metrics read it like any local family
+        "job_loss": r.gauge(
+            "veles_sched_job_loss",
+            "Live training loss per job (federated from the gang)",
+            labels=("job", "tenant")),
+        "job_samples": r.gauge(
+            "veles_sched_job_samples_per_s",
+            "Live training throughput per job (federated)",
+            labels=("job", "tenant")),
+        "job_mfu": r.gauge(
+            "veles_sched_job_mfu",
+            "Live model FLOPs utilization per job (federated)",
+            labels=("job", "tenant")),
+        "beat_age": r.gauge(
+            "veles_sched_beat_age_s",
+            "Seconds since the job's last beat-carried telemetry "
+            "delta (feeds gang_silent)", labels=("job", "tenant")),
+        "loss_age": r.gauge(
+            "veles_sched_job_loss_age_s",
+            "Seconds since the job's loss last CHANGED (feeds "
+            "job_loss_plateau)", labels=("job", "tenant")),
     }
 
 
@@ -200,6 +233,10 @@ class Job(object):
     def __init__(self, spec, metrics=None, now=None):
         self.id = "job-%d" % next(_ids)
         self.spec = spec
+        #: ONE trace id for the job's whole life — every grant's
+        #: workers, their spans and flight records, and the
+        #: scheduler's own sched_job_failed record correlate under it
+        self.trace_id = uuid.uuid4().hex[:16]
         self.state = PENDING
         self.submitted_t = time.time() if now is None else now
         #: when the job last became runnable (PENDING or PREEMPTED) —
@@ -209,6 +246,11 @@ class Job(object):
         self.finished_t = None
         self.preempted_t = None        # perf_counter at last preempt
         self.preempt_resume_s = None   # last measured preempt->resume
+        self.queue_wait_s = None       # submit -> FIRST placement
+        #: last federated view of the gang's training signal:
+        #: loss / samples_per_s / mfu plus beat_t (last delta) and
+        #: loss_t (last loss CHANGE) wall times
+        self.live = {}
         self.granted_world = 0
         self.slots = ()
         self.procs = []
@@ -240,6 +282,8 @@ class Job(object):
         if to == RUNNING:
             if self.started_t is None:
                 self.started_t = now
+                self.queue_wait_s = now - self.submitted_t
+                self._metrics["queue_wait"].observe(self.queue_wait_s)
             if self.preempted_t is not None:
                 self.preempt_resume_s = \
                     time.perf_counter() - self.preempted_t
@@ -258,18 +302,35 @@ class Job(object):
                 tenant=self.spec.tenant, state=to).inc()
         return self
 
+    def live_view(self, now=None):
+        """The federated live-metrics slice of the /jobs.json row:
+        loss / throughput / MFU plus the last-beat age."""
+        if not self.live:
+            return {}
+        now = time.time() if now is None else now
+        view = {key: self.live[key] for key
+                in ("loss", "samples_per_s", "mfu")
+                if key in self.live}
+        beat_t = self.live.get("beat_t")
+        if beat_t is not None:
+            view["beat_age_s"] = round(now - beat_t, 3)
+        return view
+
     def to_dict(self):
         """The /jobs.json row."""
         return {
             "id": self.id, "name": self.spec.name,
             "tenant": self.spec.tenant, "qos": self.spec.qos,
+            "trace_id": self.trace_id,
             "state": self.state, "world": self.granted_world,
             "world_range": [self.spec.world_min, self.spec.world_max],
             "slots": list(self.slots),
             "submitted_t": self.submitted_t,
             "started_t": self.started_t,
             "finished_t": self.finished_t,
+            "queue_wait_s": self.queue_wait_s,
             "preemptions": self.preemptions,
             "preempt_resume_s": self.preempt_resume_s,
+            "metrics": self.live_view(),
             "error": self.error,
         }

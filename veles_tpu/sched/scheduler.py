@@ -30,7 +30,15 @@ packs submitted :class:`~veles_tpu.sched.job.Job` gangs onto it:
 
 :class:`SchedulerControl` is the loopback HTTP surface the CLI talks
 to: ``POST /submit`` (a JobSpec dict), ``GET /status``,
-``GET /jobs.json``.
+``GET /jobs.json`` — plus the ONE-pane-of-glass observability
+surface: ``POST /telemetry`` absorbs each gang rank-0's delta-encoded
+registry push into a per-job :class:`FederatedRegistry` feed, ``GET
+/metrics`` / ``/metrics.json`` serve the cluster view with
+``{job,tenant}`` labels, and ``GET /history.json?series=&since=``
+serves the bounded time-series store. Every job runs under ONE
+minted trace id (``VELES_ELASTIC_TRACE``) for its whole life, so
+worker flight records, supervisor spans, and the scheduler's
+``sched_job_failed`` record correlate.
 """
 
 import json
@@ -41,13 +49,15 @@ import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 from veles_tpu.fairshare import (DEFAULT_QOS, ShareAccount,
                                  guaranteed_share, reserved_claim)
 from veles_tpu.logger import Logger
-from veles_tpu.parallel.elastic import (ENV_COORD, ENV_GEN, ENV_RANK,
-                                        ENV_SNAPSHOTS, ENV_WORLD,
-                                        _free_port)
+from veles_tpu.parallel.elastic import (ENV_COORD, ENV_GEN, ENV_JOB,
+                                        ENV_RANK, ENV_SNAPSHOTS,
+                                        ENV_TENANT, ENV_TRACE,
+                                        ENV_WORLD, _free_port)
 from veles_tpu.sched.job import (DONE, FAILED, PENDING, PREEMPTED,
                                  RUNNING, STATES, Job, _metrics)
 
@@ -128,6 +138,13 @@ class Scheduler(Logger):
         self._accounts = {}    # tenant -> ShareAccount
         self._grant_seq = 0
         self._metrics = _metrics()
+        #: per-job federation feeds (sid = job id), fed by POST
+        #: /telemetry from each gang's rank-0 metrics pusher; lazy so
+        #: a push-less scheduler never mints the federation families
+        self._federation = None
+        #: set by SchedulerControl: the /telemetry URL spawned gangs
+        #: receive as VELES_SCHED_METRICS_URL
+        self.metrics_url = None
         self._stop = threading.Event()
         self._thread = None
 
@@ -214,13 +231,16 @@ class Scheduler(Logger):
                 job.error = "worker exited rc=%s" % (
                     [c for c in codes if c not in (None, 0)][0],)
                 job.transition(FAILED, now)
+                self._drop_job_view_locked(job)
                 self.warning("%s failed: %s", job.id, job.error)
                 from veles_tpu.telemetry.flight import get_recorder
                 get_recorder().dump("sched_job_failed",
-                                    job=job.to_dict(), rc=codes)
+                                    job=job.to_dict(), rc=codes,
+                                    trace_id=job.trace_id)
             elif all(code == 0 for code in codes):
                 self._release_locked(job, now)
                 job.transition(DONE, now)
+                self._drop_job_view_locked(job)
                 self.info("%s done (world=%d, %d preemption%s)",
                           job.id, job.granted_world, job.preemptions,
                           "" if job.preemptions == 1 else "s")
@@ -330,6 +350,14 @@ class Scheduler(Logger):
             env[ENV_GEN] = str(self._grant_seq)
             env[ENV_WORLD] = str(world)
             env[ENV_RANK] = str(rank)
+            # trace correlation + the job view: every grant of this
+            # job (resumes included) runs under the SAME trace id,
+            # and rank 0 pushes its registry deltas back to us
+            env[ENV_TRACE] = job.trace_id
+            env[ENV_JOB] = job.id
+            env[ENV_TENANT] = job.spec.tenant
+            if self.metrics_url:
+                env["VELES_SCHED_METRICS_URL"] = self.metrics_url
             if coord:
                 env[ENV_COORD] = coord
             else:
@@ -393,6 +421,104 @@ class Scheduler(Logger):
 
     # -- telemetry ---------------------------------------------------------
 
+    #: gang registry families mirrored into the per-job view:
+    #: (federated family, Job.live key, _metrics key, mirror family)
+    _LIVE_FAMILIES = (
+        ("veles_train_loss", "loss", "job_loss",
+         "veles_sched_job_loss"),
+        ("veles_train_samples_per_s", "samples_per_s",
+         "job_samples", "veles_sched_job_samples_per_s"),
+        ("veles_step_mfu", "mfu", "job_mfu",
+         "veles_sched_job_mfu"),
+    )
+
+    def absorb_telemetry(self, job_id, delta):
+        """Merge one POST ``/telemetry`` delta (a gang rank-0 push)
+        into the job's federation feed; returns the ack hints
+        (``{"resync": True}`` asks the pusher for a full snapshot).
+        A feed from a job we no longer track is GC'd, not stored."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            live = job is not None and not job.terminal
+            if live and self._federation is None:
+                from veles_tpu.telemetry.federation import \
+                    FederatedRegistry
+                self._federation = FederatedRegistry()
+            federation = self._federation
+        if not live or federation is None:
+            if federation is not None:
+                federation.remove_slave(job_id)
+            return {}
+        # apply OUTSIDE the scheduler lock (the feed has its own),
+        # then re-check liveness — the gang may have been reaped
+        # while the delta merged
+        hints = federation.apply(job_id, delta)
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None or job.terminal:
+                federation.remove_slave(job_id)
+                return {}
+            job.live["beat_t"] = time.time()
+        return hints or {}
+
+    def _drop_job_view_locked(self, job):
+        """GC a terminal job's federation feed and mirror gauges
+        (history keeps its points until retention ages them out)."""
+        if self._federation is not None:
+            self._federation.remove_slave(job.id)
+        job_id = job.id
+        for _, _, metric, _ in self._LIVE_FAMILIES:
+            self._metrics[metric].remove(job=job_id)
+        self._metrics["beat_age"].remove(job=job_id)
+        self._metrics["loss_age"].remove(job=job_id)
+
+    def _publish_jobs_locked(self, now):
+        """Fold the federation feeds into the per-job mirror gauges
+        and the history store — the live half of /jobs.json."""
+        if self._federation is None:
+            return
+        latest = {}
+        for sid, tag, name, _, data in self._federation.series_rows():
+            if tag != "g":
+                continue
+            for family, key, _, _ in self._LIVE_FAMILIES:
+                if name == family:
+                    latest.setdefault(sid, {})[key] = data
+        from veles_tpu.telemetry.timeseries import get_history
+        history = get_history()
+        for job in self._jobs.values():
+            if job.terminal:
+                continue
+            fresh = latest.get(job.id)
+            if fresh:
+                if "loss" in fresh and \
+                        fresh["loss"] != job.live.get("loss"):
+                    job.live["loss_t"] = now
+                job.live.update(fresh)
+            if not job.live:
+                continue
+            job_id, tenant = job.id, job.spec.tenant
+            for _, key, metric, mirror in self._LIVE_FAMILIES:
+                value = job.live.get(key)
+                if value is None:
+                    continue
+                self._metrics[metric].labels(
+                    job=job_id, tenant=tenant).set(value)
+                # only a RUNNING gang appends history: a preempted
+                # job's series must show the gap, not a flat line
+                if job.state == RUNNING:
+                    history.record(
+                        mirror, {"job": job_id, "tenant": tenant},
+                        value, now=now)
+            beat_t = job.live.get("beat_t")
+            if beat_t is not None:
+                self._metrics["beat_age"].labels(
+                    job=job_id, tenant=tenant).set(now - beat_t)
+            loss_t = job.live.get("loss_t")
+            if loss_t is not None:
+                self._metrics["loss_age"].labels(
+                    job=job_id, tenant=tenant).set(now - loss_t)
+
     def _publish_locked(self, now):
         counts = dict.fromkeys(STATES, 0)
         oldest = 0.0
@@ -411,9 +537,47 @@ class Scheduler(Logger):
         self._metrics["devices"].labels(state="held").set(
             self.pool.held)
         self._metrics["oldest_wait"].set(oldest)
-        for tenant in self._accounts:
+        accounts = self._accounts.values()
+        for tenant, account in self._accounts.items():
             self._metrics["tenant_wait"].labels(tenant=tenant).set(
                 waits.get(tenant, 0.0))
+            share = guaranteed_share(self.pool.size, account,
+                                     accounts, now,
+                                     self.activity_window_s)
+            self._metrics["share_fraction"].labels(
+                tenant=tenant).set(share / self.pool.size)
+        self._publish_jobs_locked(now)
+
+    def cluster_snapshot(self):
+        """The ONE cluster view: the scheduler's own registry
+        snapshot with every job feed's series folded in under
+        ``{job, tenant}`` labels — the /metrics(.json) body."""
+        from veles_tpu.telemetry.registry import get_registry
+        with self._lock:
+            tenants = {job.id: job.spec.tenant
+                       for job in self._jobs.values()}
+            federation = self._federation
+        snap = get_registry().snapshot()
+        if federation is None:
+            return snap
+        kind_of = {"c": "counters", "g": "gauges", "h": "histograms"}
+        for sid, tag, name, labels, data in federation.series_rows():
+            bucket = snap[kind_of[tag]]
+            family = bucket.get(name)
+            if family is None:
+                family = bucket[name] = {"help": "", "series": []}
+            labels = dict(labels)
+            labels["job"] = sid
+            tenant = tenants.get(sid)
+            if tenant:
+                labels["tenant"] = tenant
+            if tag == "h":
+                entry = dict(data)
+                entry["labels"] = labels
+            else:
+                entry = {"value": data, "labels": labels}
+            family["series"].append(entry)
+        return snap
 
     def stats(self, now=None):
         now = time.time() if now is None else now
@@ -434,6 +598,10 @@ class Scheduler(Logger):
                         "share": round(guaranteed_share(
                             self.pool.size, a, self._accounts.values(),
                             now, self.activity_window_s), 1),
+                        "share_fraction": round(guaranteed_share(
+                            self.pool.size, a, self._accounts.values(),
+                            now, self.activity_window_s)
+                            / self.pool.size, 4),
                     } for a in self._accounts.values()},
             }
 
@@ -476,6 +644,7 @@ class Scheduler(Logger):
                         self._release_locked(job, time.time())
                         job.error = "scheduler stopped"
                         job.transition(FAILED)
+                        self._drop_job_view_locked(job)
 
 
 class _ControlHandler(BaseHTTPRequestHandler):
@@ -492,16 +661,55 @@ class _ControlHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _reply_text(self, body, content_type="text/plain"):
+        data = body.encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):
         scheduler = self.server.owner.scheduler
         if self.path.startswith("/status"):
             self._reply(scheduler.stats())
         elif self.path.startswith("/jobs.json"):
             self._reply(scheduler.jobs_report())
+        elif self.path.startswith("/history.json"):
+            query = parse_qs(urlsplit(self.path).query)
+            from veles_tpu.telemetry.timeseries import get_history
+            try:
+                self._reply(get_history().query(
+                    series=(query.get("series") or [None])[0],
+                    since=(query.get("since") or [None])[0]))
+            except (TypeError, ValueError):
+                self._reply({"error": "bad since cursor"}, code=400)
+        elif self.path.startswith("/metrics.json"):
+            self._reply(scheduler.cluster_snapshot())
+        elif self.path.startswith("/metrics"):
+            from veles_tpu.telemetry.registry import render_snapshot
+            self._reply_text(
+                render_snapshot(scheduler.cluster_snapshot()),
+                content_type="text/plain; version=0.0.4")
         else:
             self._reply({"error": "not found"}, code=404)
 
     def do_POST(self):
+        scheduler = self.server.owner.scheduler
+        if self.path.startswith("/telemetry"):
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                data = json.loads(
+                    self.rfile.read(length).decode("utf-8"))
+                hints = scheduler.absorb_telemetry(
+                    str(data.get("job") or ""),
+                    data.get("telemetry"))
+            except (TypeError, ValueError, KeyError) as e:
+                self._reply({"error": str(e) or type(e).__name__},
+                            code=400)
+                return
+            self._reply(hints)
+            return
         if not self.path.startswith("/submit"):
             self._reply({"error": "not found"}, code=404)
             return
@@ -509,8 +717,7 @@ class _ControlHandler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", 0))
             data = json.loads(self.rfile.read(length).decode("utf-8"))
             from veles_tpu.sched.job import JobSpec
-            job = self.server.owner.scheduler.submit(
-                JobSpec.from_dict(data))
+            job = scheduler.submit(JobSpec.from_dict(data))
         except (TypeError, ValueError, KeyError) as e:
             self._reply({"error": str(e) or type(e).__name__},
                         code=400)
@@ -520,9 +727,12 @@ class _ControlHandler(BaseHTTPRequestHandler):
 
 class SchedulerControl(Logger):
     """Loopback HTTP control plane for one scheduler: ``POST
-    /submit``, ``GET /status``, ``GET /jobs.json``. Binds loopback by
-    default — the submit surface executes commands, so exposing it
-    beyond the host is an operator's explicit choice."""
+    /submit`` + ``POST /telemetry`` (gang metrics pushes), ``GET
+    /status``, ``GET /jobs.json``, and the cluster observability
+    surface ``GET /metrics`` / ``/metrics.json`` /
+    ``/history.json?series=&since=``. Binds loopback by default —
+    the submit surface executes commands, so exposing it beyond the
+    host is an operator's explicit choice."""
 
     def __init__(self, scheduler, host="127.0.0.1", port=0):
         super(SchedulerControl, self).__init__()
@@ -532,6 +742,9 @@ class SchedulerControl(Logger):
         self._server.owner = self
         self._server.daemon_threads = True
         self.address = self._server.server_address
+        # spawned gangs learn where to push their registry deltas
+        scheduler.metrics_url = (
+            "http://127.0.0.1:%d/telemetry" % self.address[1])
         self._thread = None
 
     @property

@@ -22,6 +22,9 @@ Speaks the same request contract as
 * ``GET /profile.json`` — the performance-attribution report
   (:func:`veles_tpu.telemetry.profiler.profile_report`): per-bucket
   forward cost/roofline rows, memory sample, startup phases.
+* ``GET /history.json?series=&since=`` — retained metric history from
+  the bounded :class:`~veles_tpu.telemetry.timeseries.SeriesStore`
+  (the canary-comparison substrate).
 * ``GET /healthz`` — liveness + current model name/version (every
   hosted model listed under ``"models"``).
 
@@ -269,6 +272,10 @@ class ServingFrontend(Logger):
         for entry in self.entries.values():
             if entry.autoscaler is not None:
                 entry.autoscaler.start()
+        # retained metric history behind GET /history.json (QPS /
+        # latency series for canary comparison and sparklines)
+        from veles_tpu.telemetry.timeseries import get_history
+        get_history().start()
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name="serving-http")
@@ -381,6 +388,17 @@ class ServingFrontend(Logger):
         elif handler.path.startswith("/alerts.json"):
             from veles_tpu.telemetry import alerts
             self._respond(handler, 200, alerts.get_engine().report())
+        elif handler.path.startswith("/history.json"):
+            from urllib.parse import parse_qs, urlsplit
+            from veles_tpu.telemetry.timeseries import get_history
+            query = parse_qs(urlsplit(handler.path).query)
+            try:
+                self._respond(handler, 200, get_history().query(
+                    series=(query.get("series") or [None])[0],
+                    since=(query.get("since") or [None])[0]))
+            except (TypeError, ValueError):
+                self._respond(handler, 400,
+                              {"error": "bad since cursor"})
         elif handler.path.startswith("/metrics.json"):
             out = self.default_entry.snapshot()
             if len(self.entries) > 1:

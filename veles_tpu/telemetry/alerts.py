@@ -253,6 +253,28 @@ DEFAULT_RULES = (
                     "over 2 minutes while others run — weighted-fair "
                     "placement is not reaching it (weights, pool "
                     "size, or a stuck victim gang)"},
+    {"name": "job_loss_plateau",
+     "metric": "veles_sched_job_loss_age_s", "agg": "max", "op": ">",
+     "threshold": 600.0, "for_s": 30.0, "clear_for_s": 30.0,
+     "description": "some job's federated training loss has not "
+                    "CHANGED for over 10 minutes while its gang keeps "
+                    "beating — training is wedged (dead optimizer, "
+                    "zero LR, or a stuck input pipeline), not dead"},
+    {"name": "job_mfu_collapse",
+     "metric": "veles_sched_job_mfu", "agg": "min", "op": "<",
+     "threshold": 0.05, "for_s": 60.0, "clear_for_s": 60.0,
+     "description": "some job's model FLOPs utilization has sat "
+                    "under 5% for a minute — the gang is burning its "
+                    "grant on stalls (input wait, host sync, or a "
+                    "pathological shard layout)"},
+    {"name": "gang_silent",
+     "metric": "veles_sched_beat_age_s", "agg": "max", "op": ">",
+     "threshold": 30.0, "for_s": 10.0, "clear_for_s": 10.0,
+     "severity": "critical",
+     "description": "a RUNNING gang has pushed no beat-carried "
+                    "telemetry delta for 30+ seconds — its rank-0 "
+                    "pusher (or the whole gang) is hung while the "
+                    "processes still look alive to the scheduler"},
 )
 
 

@@ -128,6 +128,14 @@ class FusedRunner(Logger):
             labels=("phase",))
         self._epoch_ms = registry.histogram(
             "veles_epoch_ms", "End-to-end epoch wall time")
+        # the live job view (ISSUE 19): last-batch loss + epoch
+        # throughput as gauges, so the federation/history plane has a
+        # per-process training signal to carry without parsing logs
+        self._m_loss = registry.gauge(
+            "veles_train_loss", "Last training batch loss")
+        self._m_samples_per_s = registry.gauge(
+            "veles_train_samples_per_s",
+            "Samples served per second over the last epoch")
         # the flight recorder (stall watchdog + NaN/divergence
         # detectors) and the cost book (per-op ms + step MFU) ride
         # every sweep; both are advisory and never raise into the run
@@ -478,7 +486,12 @@ class FusedRunner(Logger):
                                owait * 1e3, overlap * 100.0)
                 epochs_done += 1
                 self._epoch_index = epochs_done
-                samples_done += sum(s["samples"] for s in stats.values())
+                epoch_samples = sum(s["samples"] for s in stats.values())
+                samples_done += epoch_samples
+                self._m_loss.set(self._last_batch[0])
+                if epoch_elapsed > 0:
+                    self._m_samples_per_s.set(
+                        epoch_samples / epoch_elapsed)
         except Exception as e:
             # the crash path: persist the black box BEFORE the
             # exception unwinds the run (sweep-level failures already

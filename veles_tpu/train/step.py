@@ -994,14 +994,39 @@ class FusedTrainer(Logger):
             stats[CLASS_NAMES[klass]] = self._summarize(
                 losses, metrics, klass)
         if self.loader.class_lengths[TRAIN]:
+            t0 = time.perf_counter()
             params, states, losses, metrics = self.train_class(
                 params, states)
             stats[CLASS_NAMES[TRAIN]] = self._summarize(
                 losses, metrics, TRAIN)
+            # _summarize forced the sync, so this elapsed covers the
+            # whole sweep — the live-view gauges + MFU ride on it
+            self._publish_live(stats[CLASS_NAMES[TRAIN]],
+                               time.perf_counter() - t0)
             self.loader.epoch_number = epoch + 1
             if self.loader.epoch_number <= self.loader.shuffle_limit:
                 self.loader.shuffle()
         return params, states, stats
+
+    def _publish_live(self, train_stats, elapsed_s):
+        """The live job view (ISSUE 19) for the class-level loop:
+        FusedRunner publishes the same families on the launcher path;
+        this keeps runs driving :meth:`run_epoch` directly (elastic
+        workers, scheduled gangs) feeding the federation plane too."""
+        from veles_tpu.telemetry import profiler
+        from veles_tpu.telemetry.registry import get_registry
+        registry = get_registry()
+        registry.gauge(
+            "veles_train_loss",
+            "Last training batch loss").set(train_stats["loss"])
+        if elapsed_s > 0:
+            registry.gauge(
+                "veles_train_samples_per_s",
+                "Samples served per second over the last epoch").set(
+                train_stats["samples"] / elapsed_s)
+        profiler.get_cost_book().record_step_mfu(
+            getattr(self, "_op_prefix", "") + "train_segment",
+            elapsed_s)
 
     def _summarize(self, losses, metrics, klass):
         n = self.loader.class_lengths[klass]

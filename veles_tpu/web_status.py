@@ -24,11 +24,13 @@ import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 from veles_tpu.config import root
 from veles_tpu.logger import Logger
 from veles_tpu.telemetry import alerts, federation, profiler
 from veles_tpu.telemetry.registry import get_registry
+from veles_tpu.telemetry.timeseries import get_history
 
 GARBAGE_TIMEOUT = 60
 
@@ -60,7 +62,8 @@ th { background: #eee; }
 <h2 id="jobs-h" style="display:none">scheduled jobs</h2>
 <table id="jobs" style="display:none"><thead><tr>
 <th>id</th><th>name</th><th>tenant</th><th>qos</th><th>state</th>
-<th>world</th><th>preempts</th><th>resume s</th><th>error</th>
+<th>world</th><th>preempts</th><th>resume s</th>
+<th>loss</th><th>MFU</th><th>error</th>
 </tr></thead><tbody></tbody></table>
 <script>
 function servingCell(s) {
@@ -165,8 +168,50 @@ async function refresh() {
     tbody.appendChild(tr);
   }
 }
+const HIST = {};   // "family|job" -> [[t, v], ...]
+function sparkline(points, width, height) {
+  if (!points || points.length < 2) return "";
+  const ts = points.map(p => p[0]), vs = points.map(p => p[1]);
+  const t0 = Math.min(...ts), t1 = Math.max(...ts);
+  const v0 = Math.min(...vs), v1 = Math.max(...vs);
+  const sx = t => (t1 > t0 ? (t - t0) / (t1 - t0) : 0) *
+    (width - 2) + 1;
+  const sy = v => height - 1 -
+    (v1 > v0 ? (v - v0) / (v1 - v0) : 0.5) * (height - 2);
+  // lift the pen across a gap over 5x the median spacing — a
+  // preemption window stays VISIBLE instead of being bridged
+  const gaps = [];
+  for (let i = 1; i < ts.length; i++) gaps.push(ts[i] - ts[i - 1]);
+  gaps.sort((a, b) => a - b);
+  const lift = gaps.length
+    ? gaps[Math.floor(gaps.length / 2)] * 5 : 1e9;
+  let d = "", pen = false;
+  for (let i = 0; i < points.length; i++) {
+    if (i && ts[i] - ts[i - 1] > lift) pen = false;
+    d += (pen ? "L" : "M") + sx(ts[i]).toFixed(1) + " " +
+      sy(vs[i]).toFixed(1) + " ";
+    pen = true;
+  }
+  return "<svg width='" + width + "' height='" + height +
+    "'><path d='" + d + "' fill='none' stroke='#36c'/></svg>";
+}
+async function refreshHist() {
+  try {
+    const resp = await fetch("/history.json?series=veles_sched_job_");
+    const data = await resp.json();
+    for (const s of data.series || [])
+      HIST[s.name + "|" + (s.labels.job || "")] = s.points;
+  } catch (e) {}
+}
+function liveCell(family, jobId) {
+  const pts = HIST[family + "|" + jobId];
+  const last = pts && pts.length ? pts[pts.length - 1][1] : null;
+  return sparkline(pts, 90, 18) +
+    (last == null ? "" : " " + (+last).toFixed(3));
+}
 async function refreshJobs() {
   try {
+    await refreshHist();
     const resp = await fetch("/jobs.json");
     const jobs = (await resp.json()).jobs || [];
     const show = jobs.length ? "" : "none";
@@ -181,12 +226,20 @@ async function refreshJobs() {
       for (const v of [j.id, j.name, j.tenant, j.qos, j.state,
                        j.world, j.preemptions,
                        j.preempt_resume_s == null ? ""
-                         : j.preempt_resume_s.toFixed(2),
-                       j.error]) {
+                         : j.preempt_resume_s.toFixed(2)]) {
         const td = document.createElement("td");
         td.textContent = v === undefined || v === null ? "" : String(v);
         tr.appendChild(td);
       }
+      for (const family of ["veles_sched_job_loss",
+                            "veles_sched_job_mfu"]) {
+        const td = document.createElement("td");
+        td.innerHTML = liveCell(family, j.id);
+        tr.appendChild(td);
+      }
+      const td = document.createElement("td");
+      td.textContent = j.error == null ? "" : String(j.error);
+      tr.appendChild(td);
       tbody.appendChild(tr);
     }
   } catch (e) {}
@@ -631,6 +684,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(alerts.get_engine().report())
         elif self.path.startswith("/jobs.json"):
             self._reply(self.server.owner.jobs_report())
+        elif self.path.startswith("/history.json"):
+            query = parse_qs(urlsplit(self.path).query)
+            try:
+                self._reply(get_history().query(
+                    series=(query.get("series") or [None])[0],
+                    since=(query.get("since") or [None])[0]))
+            except (TypeError, ValueError):
+                self._reply({"error": "bad since cursor"}, code=400)
         elif self.path.startswith("/metrics.json"):
             # cluster-wide: local registry + federated slave series
             self._reply(federation.cluster_snapshot())
@@ -727,8 +788,8 @@ class WebStatusServer(Logger):
         "/", "/status.html", "/logs.html", "/slaves.html",
         "/frontend.html", "/workflow.html", "/timeline.html", "/catalog",
         "/metrics", "/metrics.json", "/profile.json", "/cluster.json",
-        "/alerts.json", "/jobs.json", "/update", "/service", "/logs",
-        "/events"])
+        "/alerts.json", "/jobs.json", "/history.json", "/update",
+        "/service", "/logs", "/events"])
 
     def count_request(self, path):
         path = path.split("?")[0] or "/"
@@ -778,12 +839,39 @@ class WebStatusServer(Logger):
                     jobs.append(dict(job, scheduler=mid))
         return {"jobs": jobs}
 
+    #: pushed job-row live metrics fed into the history store (the
+    #: scheduler is a DIFFERENT process; its pushes are the only
+    #: source this dashboard's sparklines have)
+    _JOB_HISTORY = (("loss", "veles_sched_job_loss"),
+                    ("samples_per_s", "veles_sched_job_samples_per_s"),
+                    ("mfu", "veles_sched_job_mfu"))
+
+    def _record_job_history(self, jobs):
+        history = get_history()
+        for job in jobs:
+            if not isinstance(job, dict):
+                continue
+            if job.get("state") != "running":
+                continue   # a preemption gap must stay visible
+            metrics = job.get("metrics") or {}
+            job_id = str(job.get("id"))
+            tenant = str(job.get("tenant"))
+            for key, family in self._JOB_HISTORY:
+                value = metrics.get(key)
+                if isinstance(value, (int, float)):
+                    history.record(family,
+                                   {"job": job_id, "tenant": tenant},
+                                   value)
+
     def receive_update(self, data):
         """A master's periodic status (``web_status.py:244-251``)."""
         mid = data["id"]
         with self._lock:
             self.masters[mid] = dict(data, last_update=time.time())
         self._m_updates.inc()
+        jobs = data.get("jobs")
+        if jobs:
+            self._record_job_history(jobs)
         self.debug("master %s yielded an update", mid)
 
     @staticmethod
@@ -842,6 +930,9 @@ class WebStatusServer(Logger):
 
     def run(self):
         """Serve until :meth:`stop` (blocking, like the reference)."""
+        # local registry history (request counters etc.); the job
+        # sparklines are fed by _record_job_history instead
+        get_history().start()
         self.info("HTTP server is running on %s:%d", *self.address)
         self._server.serve_forever()
 
