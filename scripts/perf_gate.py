@@ -633,6 +633,69 @@ def _sched_probe():
             "sched_loss_parity": float(summary["sched_loss_parity"])}
 
 
+def _sched_restart_probe():
+    """ISSUE 20 (report-only): the durable-scheduler chaos leg —
+    SIGKILL a `sched serve --state-dir` subprocess mid-contention and
+    restart it on the same dir. The bench hard-fails unless the
+    surviving gang is adopted and both loss curves stay bit-equal to
+    uninterrupted baselines; what the gate tracks is the measured
+    restart -> serving-again wall time (journal replay + pid probe +
+    adoption), which carries real python startup cost on a shared
+    runner and so only reports."""
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json",
+                                     delete=False) as f:
+        path = f.name
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(HERE, "scripts", "sched_bench.py"),
+             "--quick", "--chaos", "sched-kill", "--json", path],
+            capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError("sched restart probe failed:\n%s"
+                               % out.stderr[-3000:])
+        with open(path) as f:
+            summary = json.load(f)
+    finally:
+        os.unlink(path)
+    return {"sched_restart_recovery_s":
+            float(summary["sched_restart_recovery_s"])}
+
+
+def _sched_journal_probe(n_jobs=200):
+    """ISSUE 20 (report-only): what the fsync'd write-ahead journal
+    costs on the scheduler's bookkeeping path. Submits N jobs into a
+    scheduler whose pool is fully blocked (placement never spawns —
+    pure submit + journal-append work), with and without a state
+    dir, and reports the wall ratio. Report-only: fsync latency is
+    the filesystem's to decide on a shared runner."""
+    import tempfile
+
+    from veles_tpu.sched import JobSpec, Scheduler
+
+    def measure(state_dir):
+        sched = Scheduler(1, tick_s=3600.0, state_dir=state_dir)
+        sched.pool.hold("blocker", 0, sched.pool.size)
+        t0 = time.perf_counter()
+        for i in range(n_jobs):
+            sched.submit(JobSpec(
+                name="journal-probe-%d" % i,
+                argv=[sys.executable, "-c", "pass"],
+                tenant="bench"))
+        wall = time.perf_counter() - t0
+        sched.stop()
+        return wall
+
+    t_memory = measure(None)
+    with tempfile.TemporaryDirectory(prefix="sched-journal-") as d:
+        t_journal = measure(d)
+    return {"sched_journal_overhead_ratio":
+            t_journal / max(t_memory, 1e-9)}
+
+
 def _serving_elastic_probe(delay_s=0.01, backlog=120):
     """ISSUE 14 autoscale guard (report-only): a real replica pool on
     a tiny jitted model, flooded so the queue breaches; measured are
@@ -739,6 +802,8 @@ def capture():
     metrics.update(_serving_cache_probe())
     metrics.update(_serving_elastic_probe())
     metrics.update(_sched_probe())
+    metrics.update(_sched_restart_probe())
+    metrics.update(_sched_journal_probe())
     return {"schema": "veles-perf-snapshot/1",
             "probe": {"samples": SAMPLES, "batch": BATCH,
                       "epochs": EPOCHS, "seed": SEED},

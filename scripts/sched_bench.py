@@ -29,6 +29,19 @@ Scheduler state changes stream as ``EVENT`` markers on stderr in the
 elastic supervisor's announce format, so a log reader can line this
 bench up with `bench_distributed.py --chaos` output.
 
+``--chaos sched-kill`` (ISSUE 20) runs the durability scenario
+instead: the scheduler runs as a REAL subprocess (``python -m
+veles_tpu sched serve --state-dir``), the same two-tenant contention
+is staged through its HTTP control endpoint, and then the scheduler
+process is SIGKILLed while the research job sits PREEMPTED and the
+prod gang is mid-epoch. A replacement serve on the SAME state dir and
+SAME port must adopt the surviving prod gang without killing it
+(same job id, still RUNNING, ``veles_sched_gangs_adopted_total``
+moves), resume the research job under its original trace id, and
+finish BOTH jobs with loss curves bit-identical to uninterrupted
+baselines. The restart -> serving wall time is the summary's
+``sched_restart_recovery_s`` (report-only in the perf gate).
+
 Prints one JSON line per leg and a ``summary`` line the perf gate and
 `bench_all.py` consume.
 
@@ -36,12 +49,15 @@ Usage::
 
     JAX_PLATFORMS=cpu python scripts/sched_bench.py [--epochs 4]
         [--epoch-sleep 0.4] [--quick] [--json OUT]
+        [--chaos sched-kill]
 """
 
 import argparse
 import json
 import logging
 import os
+import re
+import signal
 import subprocess
 import sys
 import tempfile
@@ -119,6 +135,172 @@ def wait_for_manifest(snaps, timeout_s=240.0):
                 return dirpath
         time.sleep(0.1)
     raise SystemExit("no checkpoint manifest appeared in %s" % snaps)
+
+
+def http_post(port, path, payload):
+    from urllib.request import Request, urlopen
+    req = Request("http://127.0.0.1:%d%s" % (port, path),
+                  data=json.dumps(payload).encode("utf-8"),
+                  headers={"Content-Type": "application/json"})
+    with urlopen(req, timeout=10.0) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def wait_for_state(port, job_id, want, timeout_s=240.0):
+    terminal = ("done", "failed")
+    deadline = time.monotonic() + timeout_s
+    row = None
+    while time.monotonic() < deadline:
+        row = job_row(port, job_id)
+        if row["state"] == want:
+            return row
+        if row["state"] in terminal and want not in terminal:
+            raise SystemExit(
+                "job %s went %s while waiting for %s (error=%r)"
+                % (job_id, row["state"], want, row.get("error")))
+        time.sleep(0.05)
+    raise SystemExit("job %s never reached %s (last state %r)"
+                     % (job_id, want, row and row["state"]))
+
+
+def metric_total(port, family):
+    """Sum a counter family off the scheduler's /metrics text."""
+    total = 0.0
+    pattern = re.compile(
+        r"^%s(?:\{[^}]*\})? ([0-9.eE+-]+)$" % re.escape(family))
+    for line in http_get(port, "/metrics").splitlines():
+        m = pattern.match(line)
+        if m:
+            total += float(m.group(1))
+    return total
+
+
+def spawn_serve(state_dir, log_dir, addr, env, errlog):
+    """Start ``sched serve`` as a real subprocess and block until its
+    SCHED announce line — printed only after journal replay and gang
+    adoption finished, so returning == the control plane serves 200s."""
+    argv = [sys.executable, "-m", "veles_tpu", "sched", "serve",
+            "--pool", "1", "--tick-s", "0.05", "--min-run-s", "0.5",
+            "--addr", addr, "--log-dir", log_dir,
+            "--state-dir", state_dir]
+    proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=errlog, text=True)
+    line = proc.stdout.readline()
+    if not line.startswith("SCHED "):
+        proc.kill()
+        proc.wait()
+        raise SystemExit("sched serve never announced (got %r); see %s"
+                         % (line, getattr(errlog, "name", "stderr")))
+    host, _, port = line.split()[1].rpartition(":")
+    return proc, int(port)
+
+
+def run_chaos_sched_kill(workdir, epochs, epoch_sleep, env):
+    """SIGKILL the scheduler process mid-contention; the restart on
+    the same state dir must adopt, resume, and change NO math."""
+    # the prod gang must outlive the scheduler outage (kill + python
+    # startup + replay) or there is nothing left to adopt — pace it
+    # with a generous per-epoch sleep (no RNG impact on the curve)
+    prod_epochs, prod_sleep = 2, 4.0
+    env = dict(env)
+    env["VELES_SCHED_METRICS_S"] = "0.1"
+    state_dir = os.path.join(workdir, "state")
+    log_dir = os.path.join(workdir, "logs")
+    snaps = os.path.join(workdir, "snaps")
+    research_out = os.path.join(workdir, "research.json")
+    prod_out = os.path.join(workdir, "prod.json")
+    base_research = os.path.join(workdir, "base-research.json")
+    base_prod = os.path.join(workdir, "base-prod.json")
+
+    announce("sched_chaos_baselines")
+    for out, n, sleep_s in ((base_research, epochs, epoch_sleep),
+                            (base_prod, prod_epochs, prod_sleep)):
+        proc = subprocess.run(demo_argv(out, n, sleep_s), env=env,
+                              capture_output=True, timeout=600)
+        if proc.returncode != 0:
+            raise SystemExit(
+                "chaos baseline failed:\n%s"
+                % proc.stderr.decode(errors="replace")[-3000:])
+
+    errlog = open(os.path.join(workdir, "serve.log"), "ab")
+    t0 = time.time()
+    proc, port = spawn_serve(state_dir, log_dir, "127.0.0.1:0", env,
+                             errlog)
+    announce("sched_chaos_serve", port=port)
+    recovery_s = None
+    try:
+        research_id = http_post(port, "/submit", {
+            "name": "research-train", "tenant": "research",
+            "argv": demo_argv(research_out, epochs, epoch_sleep),
+            "snapshot_dir": snaps})["id"]
+        announce("sched_submit", job=research_id, tenant="research",
+                 preemptible=True)
+        wait_for_manifest(snaps)
+        wait_for_live_loss(port, research_id, "research")
+        trace_before = job_row(port, research_id).get("trace_id")
+        prod_id = http_post(port, "/submit", {
+            "name": "prod-train", "tenant": "prod",
+            "argv": demo_argv(prod_out, prod_epochs, prod_sleep)})["id"]
+        announce("sched_submit", job=prod_id, tenant="prod",
+                 preemptible=False)
+        # the kill lands at the worst moment: the research gang is
+        # displaced (nothing running to carry its state) and the prod
+        # gang is alive mid-epoch (everything to lose by a re-spawn)
+        wait_for_state(port, research_id, "preempted")
+        wait_for_state(port, prod_id, "running")
+        wait_for_live_loss(port, prod_id, "prod")
+        announce("sched_kill", pid=proc.pid)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+
+        t_restart = time.time()
+        proc, port = spawn_serve(state_dir, log_dir,
+                                 "127.0.0.1:%d" % port, env, errlog)
+        recovery_s = time.time() - t_restart
+        announce("sched_recovered", recovery_s="%.3f" % recovery_s)
+        adopted = metric_total(port, "veles_sched_gangs_adopted_total")
+        if adopted < 1:
+            raise SystemExit("restarted scheduler adopted no gangs "
+                             "(veles_sched_gangs_adopted_total=%s)"
+                             % adopted)
+        prod_row = job_row(port, prod_id)
+        if prod_row["state"] != "running":
+            raise SystemExit(
+                "prod gang did not survive the restart as an adopted "
+                "RUNNING job: %r" % prod_row)
+        research_row = job_row(port, research_id)
+        if research_row.get("trace_id") != trace_before:
+            raise SystemExit(
+                "research job changed trace id across the scheduler "
+                "restart: %r -> %r"
+                % (trace_before, research_row.get("trace_id")))
+        wait_for_state(port, prod_id, "done", timeout_s=600)
+        wait_for_state(port, research_id, "done", timeout_s=600)
+        if job_row(port, research_id).get("trace_id") != trace_before:
+            raise SystemExit("research trace id changed after resume")
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+            proc.wait()
+        errlog.close()
+    wall = time.time() - t0
+
+    parity = 1.0
+    for out, base in ((research_out, base_research),
+                      (prod_out, base_prod)):
+        with open(out) as f:
+            curve = json.load(f)
+        with open(base) as f:
+            base_curve = json.load(f)
+        if curve != base_curve:
+            parity = 0.0
+    row = {"leg": "chaos-sched-kill", "wall_s": round(wall, 2),
+           "restart_recovery_s": round(recovery_s, 3),
+           "gangs_adopted": adopted,
+           "loss_parity": parity,
+           "trace_id": trace_before}
+    print(json.dumps(row), flush=True)
+    return row
 
 
 def run_baseline(out, epochs, epoch_sleep, env):
@@ -244,11 +426,38 @@ def main():
                         help="CI smoke shape: 3 epochs")
     parser.add_argument("--json", metavar="OUT",
                         help="also write the summary JSON here")
+    parser.add_argument("--chaos", choices=("sched-kill",),
+                        help="run the durability scenario instead: "
+                             "SIGKILL the scheduler subprocess "
+                             "mid-contention, restart on the same "
+                             "state dir, assert adoption + parity")
     args = parser.parse_args()
     if args.quick:
         args.epochs = min(args.epochs, 3)
 
     env = worker_env()
+    if args.chaos == "sched-kill":
+        with tempfile.TemporaryDirectory(
+                prefix="sched-chaos-") as workdir:
+            chaos = run_chaos_sched_kill(workdir, args.epochs,
+                                         args.epoch_sleep, env)
+        summary = {
+            "leg": "summary", "chaos": "sched-kill",
+            "epochs": args.epochs,
+            "sched_restart_recovery_s": chaos["restart_recovery_s"],
+            "sched_gangs_adopted": chaos["gangs_adopted"],
+            "sched_chaos_loss_parity": chaos["loss_parity"],
+        }
+        print(json.dumps(summary), flush=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(summary, f, indent=1, sort_keys=True)
+                f.write("\n")
+        if chaos["loss_parity"] != 1.0:
+            raise SystemExit(
+                "the scheduler restart changed the math: a resumed "
+                "curve differs from its uninterrupted baseline")
+        return 0
     with tempfile.TemporaryDirectory(prefix="sched-bench-") as workdir:
         base_out = os.path.join(workdir, "baseline.json")
         run_baseline(base_out, args.epochs, args.epoch_sleep, env)

@@ -31,8 +31,9 @@ from veles_tpu import prng
 from veles_tpu.config import root
 from veles_tpu.fairshare import DEFAULT_QOS
 from veles_tpu.genetics import GeneticsOptimizer, Tune
-from veles_tpu.sched import (DONE, FAILED, PENDING, PREEMPTED, RUNNING,
-                             DevicePool, Job, JobSpec, Scheduler,
+from veles_tpu.sched import (DONE, FAILED, PENDING, PREEMPTED,
+                             RETRYING, RUNNING, DevicePool, Job,
+                             JobJournal, JobSpec, Scheduler,
                              SchedulerControl,
                              ScheduledEnsembleTrainManager,
                              ScheduledGeneticsOptimizer)
@@ -775,6 +776,481 @@ def test_scheduled_genetics_matches_serial_bit_exact(ga_files):
     tenants = {j.spec.tenant for j in sched.jobs()}
     assert tenants == {"genetics"}
     assert all(j.state == DONE for j in sched.jobs())
+
+
+# -- ISSUE 20: durable scheduler (journal, recovery, retry budgets) ----------
+
+
+def _await(predicate, timeout_s=30.0, poll_s=0.05):
+    """Poll (no scheduler ticks) until ``predicate()`` holds."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(poll_s)
+    raise AssertionError("condition not reached in %.0fs" % timeout_s)
+
+
+def test_fsm_retrying_budget_path():
+    job = Job(JobSpec(argv=QUICK, max_retries=2, retry_backoff_s=0.5))
+    job.transition(RUNNING)
+    job.transition(RETRYING)
+    assert job.retries == 1 and job.runnable and not job.terminal
+    # parked until the backoff hold expires; ready() is the gate
+    job.retry_at = time.time() + 60.0
+    assert not job.ready()
+    assert job.ready(now=job.retry_at + 1.0)
+    job.transition(RUNNING)
+    assert job.retry_at is None      # cleared on the resume edge
+    job.transition(RETRYING)
+    assert job.retries == 2
+    job.transition(FAILED)
+    assert job.terminal
+    assert [s for _, s in job.history] == [
+        PENDING, RUNNING, RETRYING, RUNNING, RETRYING, FAILED]
+
+
+def test_fsm_rejects_illegal_retrying_moves():
+    job = Job(JobSpec(argv=QUICK, max_retries=1))
+    with pytest.raises(InvalidTransition):
+        job.transition(RETRYING)     # pending -> retrying
+    job.transition(RUNNING)
+    job.transition(PREEMPTED)
+    with pytest.raises(InvalidTransition):
+        job.transition(RETRYING)     # preempted -> retrying
+    job.transition(RUNNING)
+    job.transition(RETRYING)
+    for state in (PREEMPTED, DONE, RETRYING):
+        with pytest.raises(InvalidTransition):
+            job.transition(state)    # retrying only resumes or fails
+
+
+def test_retrying_transitions_are_counted():
+    from veles_tpu.sched.job import _metrics
+    from veles_tpu.telemetry.registry import get_registry
+    _metrics()
+    retries = get_registry().get("veles_sched_job_retries_total")
+    before = retries.labels(tenant="budgeted").value
+    job = Job(JobSpec(argv=QUICK, tenant="budgeted", max_retries=2))
+    job.transition(RUNNING)
+    job.transition(RETRYING)
+    assert retries.labels(tenant="budgeted").value == before + 1
+
+
+def test_jobspec_rejects_negative_retry_policy():
+    with pytest.raises(ValueError):
+        JobSpec(argv=QUICK, max_retries=-1)
+    with pytest.raises(ValueError):
+        JobSpec(argv=QUICK, retry_backoff_s=-0.1)
+    spec = JobSpec(argv=QUICK, max_retries=3, retry_backoff_s=0.25)
+    again = JobSpec.from_dict(spec.to_dict())
+    assert again.max_retries == 3
+    assert again.retry_backoff_s == 0.25
+
+
+def test_pool_hold_rebuilds_journaled_grants_exactly():
+    """The recovery path: journaled grants re-imposed verbatim yield
+    the same holes the pre-crash pool had, and a journal that
+    disagrees with the pool bounds or another hold SURFACES instead
+    of silently fragmenting."""
+    first = DevicePool(8)
+    slots = {job_id: first.allocate(job_id, n)
+             for job_id, n in (("job-1", 3), ("job-2", 2))}
+    rebuilt = DevicePool(8)
+    for job_id, granted in slots.items():
+        assert rebuilt.hold(job_id, granted[0],
+                            len(granted)) == granted
+    assert rebuilt.holes() == first.holes()
+    with pytest.raises(ValueError, match="overlaps"):
+        rebuilt.hold("job-3", 2, 2)     # crosses job-1's [0, 3)
+    with pytest.raises(ValueError, match="outside"):
+        rebuilt.hold("job-3", 7, 2)
+    with pytest.raises(ValueError, match="outside"):
+        rebuilt.hold("job-3", -1, 1)
+    with pytest.raises(ValueError, match="already holds"):
+        rebuilt.hold("job-1", 6, 1)
+    assert rebuilt.hold("job-3", 6, 2) == (6, 7)
+    assert rebuilt.free == 1
+
+
+def test_journal_roundtrip_compaction_and_torn_tail(tmp_path):
+    journal = JobJournal(str(tmp_path), max_bytes=16)
+    journal.append({"ev": "submit", "n": 1})
+    journal.append({"ev": "grant", "n": 2})
+    image, events = JobJournal(str(tmp_path)).replay()
+    assert image is None
+    assert [e["n"] for e in events] == [1, 2]
+    # torn final line (the crash happened mid-write): replay stops at
+    # the tear with everything before it intact — it never raises
+    with open(journal.journal_path, "a", encoding="utf-8") as f:
+        f.write('{"ev": "rea')
+    image, events = JobJournal(str(tmp_path)).replay()
+    assert [e["n"] for e in events] == [1, 2]
+    # over max_bytes: the journal asks for compaction; compacting
+    # folds state into snapshot.json and truncates the log
+    assert journal.should_compact()
+    journal.compact({"jobs": [{"id": "job-1"}]})
+    assert not journal.should_compact()
+    image, events = JobJournal(str(tmp_path)).replay()
+    assert image == {"jobs": [{"id": "job-1"}]}
+    assert events == []
+    # a corrupt snapshot degrades to journal-only replay, not an abort
+    with open(journal.snapshot_path, "w", encoding="utf-8") as f:
+        f.write("{half a json object")
+    journal.append({"ev": "submit", "n": 3})
+    image, events = JobJournal(str(tmp_path)).replay()
+    assert image is None
+    assert [e["n"] for e in events] == [3]
+    journal.close()
+
+
+def test_job_record_roundtrip_preserves_everything():
+    job = Job(JobSpec(argv=SLEEP, tenant="acme", max_retries=2,
+                      snapshot_dir="/tmp/snaps"))
+    job.transition(RUNNING)
+    job.slots, job.granted_world = (2, 3), 2
+    job.pids = (4242, 4243)
+    job.transition(PREEMPTED)
+    again = Job.from_record(job.record())
+    assert again.id == job.id
+    assert again.trace_id == job.trace_id
+    assert again.state == PREEMPTED
+    assert again.submitted_t == job.submitted_t
+    assert again.runnable_since == job.runnable_since
+    assert again.queue_wait_s == job.queue_wait_s
+    assert again.pids == (4242, 4243)
+    assert again.slots == (2, 3)
+    assert again.preemptions == 1
+    assert again.spec.to_dict() == job.spec.to_dict()
+    assert again.history == [tuple(h) for h in job.history]
+    # journal poison is rejected, not resurrected
+    bad = job.record()
+    bad["state"] = "zombie"
+    with pytest.raises(ValueError, match="unknown state"):
+        Job.from_record(bad)
+
+
+def test_recovery_adopts_live_gangs_and_requeues_dead(
+        tmp_path, monkeypatch):
+    """The crash story, driven without real sleeps: a scheduler dies
+    holding one live gang and three dead ones. Its successor must
+    ADOPT the live gang in place (never kill it), resume the dead
+    preemptible job preempt-style, re-queue the dead job with retry
+    budget, and fail the dead job without one — preserving ids, trace
+    ids, submit clocks and the pool holds throughout."""
+    from veles_tpu.telemetry import flight
+    from veles_tpu.telemetry.registry import get_registry
+    dumps = []
+
+    class _Recorder(object):
+        def dump(self, reason, **context):
+            dumps.append((reason, context))
+
+    monkeypatch.setattr(flight, "get_recorder", lambda: _Recorder())
+    state = str(tmp_path / "state")
+    first = Scheduler(4, preempt=False, min_run_s=0.0,
+                      state_dir=state)
+    first.recover()
+    alive = first.submit(JobSpec(argv=SLEEP, tenant="a",
+                                 name="survivor"))
+    dead_pre = first.submit(JobSpec(
+        argv=SLEEP, tenant="b", snapshot_dir=str(tmp_path / "snaps")))
+    dead_retry = first.submit(JobSpec(
+        argv=SLEEP, tenant="c", max_retries=2, retry_backoff_s=0.0))
+    dead_fail = first.submit(JobSpec(argv=SLEEP, tenant="d"))
+    first.tick()
+    assert all(j.state == RUNNING for j in
+               (alive, dead_pre, dead_retry, dead_fail))
+    held_before = dict(first.pool._held)
+    # three gangs die while the scheduler is "down" (we never tick
+    # first again — it crashed); wait() reaps them deterministically
+    for job in (dead_pre, dead_retry, dead_fail):
+        for proc in job.procs:
+            proc.kill()
+            proc.wait()
+    first._journal.close()
+
+    adopted_metric = get_registry().get(
+        "veles_sched_gangs_adopted_total")
+    adopted_before = adopted_metric.value
+    second = Scheduler(4, preempt=False, min_run_s=0.0,
+                       state_dir=state)
+    assert second.recovering
+    second.recover()
+    assert not second.recovering
+    assert adopted_metric.value == adopted_before + 1
+
+    jobs = {j.id: j for j in second.jobs()}
+    assert set(jobs) == {alive.id, dead_pre.id, dead_retry.id,
+                         dead_fail.id}
+    survivor = jobs[alive.id]
+    assert survivor.state == RUNNING
+    assert survivor.trace_id == alive.trace_id
+    assert survivor.submitted_t == alive.submitted_t
+    assert survivor.pids == alive.pids
+    assert survivor.procs and survivor.procs[0].poll() is None
+    # ONLY the adopted gang still holds slots; its hold is verbatim
+    assert second.pool._held == {
+        alive.id: held_before[alive.id]}
+    assert jobs[dead_pre.id].state == PREEMPTED
+    assert jobs[dead_retry.id].state == RETRYING
+    assert jobs[dead_retry.id].retries == 1
+    assert jobs[dead_fail.id].state == FAILED
+    assert "died while scheduler was down" in jobs[dead_fail.id].error
+    by_reason = {reason: ctx for reason, ctx in dumps}
+    assert by_reason["sched_job_failed"]["trace_id"] == \
+        dead_fail.trace_id
+    # fair-share survives: tenant a's outstanding slots and every
+    # account are rebuilt from the journal
+    stats = second.stats()
+    assert set(stats["tenants"]) == {"a", "b", "c", "d"}
+    assert stats["tenants"]["a"]["held"] == 1
+    assert stats["tenants"]["a"]["granted"] >= 1
+    # freshly minted ids never collide with recovered ones
+    newcomer = second.submit(JobSpec(argv=QUICK, tenant="e"))
+    assert newcomer.id not in jobs
+    assert int(newcomer.id.split("-")[1]) > max(
+        int(i.split("-")[1]) for i in jobs)
+    # the dead-but-runnable jobs re-place on the next tick
+    second.tick()
+    assert jobs[dead_pre.id].state == RUNNING
+    assert jobs[dead_pre.id].grants == 2
+    assert jobs[dead_retry.id].state == RUNNING
+    # the adopted gang's exit is reaped (as success: a non-child's
+    # real rc is unobservable by design)
+    for proc in survivor.procs:
+        proc.kill()
+        proc.wait()
+    second.tick()
+    assert survivor.state == DONE
+    second.stop(kill=True)
+
+
+def test_recovery_is_idempotent_and_keeps_queue_wait_clock(tmp_path):
+    """Replaying twice equals replaying once, and a PENDING job's
+    queue-wait clock spans the restart instead of resetting."""
+    state = str(tmp_path / "state")
+    first = Scheduler(1, preempt=False, state_dir=state)
+    first.recover()
+    hog = first.submit(JobSpec(argv=SLEEP, tenant="a"))
+    first.tick()
+    assert hog.state == RUNNING
+    waiting = first.submit(JobSpec(argv=QUICK, tenant="b"))
+    first.tick()
+    assert waiting.state == PENDING
+    first._journal.close()
+
+    def _recover():
+        sched = Scheduler(1, preempt=False, state_dir=state)
+        sched.recover()
+        return sched
+
+    second, third = _recover(), _recover()
+    second_records = {j.id: j.record() for j in second.jobs()}
+    third_records = {j.id: j.record() for j in third.jobs()}
+    assert second_records == third_records   # replay is idempotent
+    again = second.get(waiting.id)
+    assert again.state == PENDING
+    assert again.submitted_t == waiting.submitted_t
+    assert again.runnable_since == waiting.runnable_since
+    # free the slot: the queue-wait measured at FIRST placement spans
+    # submit -> restart -> place (never reset by the replay)
+    survivor = second.get(hog.id)
+    for proc in survivor.procs:
+        proc.kill()
+        proc.wait()
+    _tick_until(second, lambda: again.state == DONE)
+    assert again.queue_wait_s >= 0.0
+    assert again.started_t - waiting.submitted_t == pytest.approx(
+        again.queue_wait_s)
+    second.stop(kill=True)
+    third.stop(kill=True)
+
+
+def test_retry_budget_respawns_then_crash_loop_fails(monkeypatch):
+    """A crashing gang with budget re-queues (RETRYING, counted) —
+    until crash_loop_k failures inside the window override any
+    remaining budget and the job lands in FAILED with ONE correlated
+    flight record."""
+    from veles_tpu.telemetry import flight
+    dumps = []
+
+    class _Recorder(object):
+        def dump(self, reason, **context):
+            dumps.append((reason, context))
+
+    monkeypatch.setattr(flight, "get_recorder", lambda: _Recorder())
+    sched = Scheduler(1, preempt=False, crash_loop_k=3,
+                      crash_loop_window_s=60.0)
+    job = sched.submit(JobSpec(argv=CRASH, tenant="flaky",
+                               max_retries=10, retry_backoff_s=0.0))
+    _tick_until(sched, lambda: job.terminal, timeout_s=60)
+    assert job.state == FAILED
+    assert job.retries == 2              # two respawns, third strike
+    assert "crash loop" in job.error
+    assert len(job.failure_times) == 3
+    # ONE terminal record, not one per retry; trace-correlated
+    assert [reason for reason, _ in dumps] == ["sched_job_failed"]
+    context = dumps[0][1]
+    assert context["trace_id"] == job.trace_id
+    assert context["retries"] == 2
+    assert len(context["failures"]) == 3
+
+
+def test_retry_backoff_parks_job_until_deadline():
+    sched = Scheduler(1, preempt=False, crash_loop_k=99)
+    job = sched.submit(JobSpec(argv=CRASH, max_retries=1,
+                               retry_backoff_s=30.0))
+    _tick_until(sched, lambda: job.state == RETRYING, timeout_s=60)
+    assert job.retry_at is not None
+    assert job.retry_at > time.time() + 2.0   # jittered exponential
+    sched.tick()
+    assert job.state == RETRYING             # parked, not respawned
+    job.retry_at = time.time()               # fast-forward the hold
+    _tick_until(sched, lambda: job.terminal, timeout_s=60)
+    assert job.state == FAILED               # budget spent
+    assert job.retries == 1
+    assert "rc=3" in job.error
+
+
+def test_control_replies_503_with_retry_after_while_recovering(
+        tmp_path):
+    sched = Scheduler(1, state_dir=str(tmp_path / "state"))
+    control = SchedulerControl(sched).start()
+    base = "http://127.0.0.1:%d" % control.port
+    try:
+        assert sched.recovering
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(base + "/status", timeout=10)
+        assert err.value.code == 503
+        assert err.value.headers["Retry-After"] == "1"
+        submit = urllib.request.Request(
+            base + "/submit",
+            data=json.dumps({"argv": QUICK}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(submit, timeout=10)
+        assert err.value.code == 503
+        sched.recover()
+        with urllib.request.urlopen(base + "/status",
+                                    timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        control.stop()
+        sched.stop()
+
+
+def test_metrics_pusher_survives_scheduler_restart(tmp_path):
+    """Satellite 1: rank-0's telemetry feed rides out a scheduler
+    restart — pushes back off while the control endpoint is down,
+    then the first success is a full resync, healing the recovered
+    scheduler's (empty) federated view including series that stopped
+    changing BEFORE the outage."""
+    from veles_tpu.parallel.elastic import _MetricsPusher
+    from veles_tpu.telemetry.registry import get_registry
+    probe = get_registry().gauge("pusher_restart_probe")
+    probe.set(41.0)
+    state = str(tmp_path / "state")
+    first = Scheduler(1, preempt=False, state_dir=state)
+    first.recover()
+    control = SchedulerControl(first).start()
+    port = control.port
+    job = first.submit(JobSpec(argv=SLEEP, tenant="acme"))
+    first.tick()
+    assert job.state == RUNNING
+    pusher = _MetricsPusher(first.metrics_url, job.id, 0.05)
+    second = control2 = None
+    try:
+        _await(lambda: first._federation is not None
+               and job.id in first._federation.slaves())
+        control.stop()                        # the outage begins
+        _await(lambda: pusher._failures >= 1)
+        first._journal.close()
+        second = Scheduler(1, preempt=False, state_dir=state)
+        control2 = SchedulerControl(second, port=port).start()
+        second.recover()                      # adopts the live gang
+        assert second.get(job.id).state == RUNNING
+
+        def _healed():
+            federation = second._federation
+            if federation is None or \
+                    job.id not in federation.slaves():
+                return False
+            return any(
+                sid == job.id and name == "pusher_restart_probe"
+                and data == 41.0
+                for sid, tag, name, _, data
+                in federation.series_rows())
+
+        _await(_healed)
+        assert pusher._failures == 0          # backoff reset
+    finally:
+        pusher.stop()
+        if control2 is not None:
+            control2.stop()
+        if second is not None:
+            second.stop(kill=True)
+
+
+FLAKY_WORKER = """\
+import os
+import sys
+
+marker, out = sys.argv[1], sys.argv[2]
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    # first attempt only: rank 0 raises mid-training at epoch 1
+    os.environ["VELES_ELASTIC_TEST_FAIL"] = "0:1"
+os.execv(sys.executable, [
+    sys.executable, "-m", "veles_tpu.parallel.elastic", "worker-demo",
+    "--out", out, "--epochs", "4"])
+"""
+
+
+def test_retry_budget_gang_converges_to_same_loss(tmp_path):
+    """ISSUE 20 acceptance: a gang that dies mid-epoch and re-runs
+    under its retry budget converges to the same final loss curve as
+    an uninterrupted run — the retry is checkpoint + restore through
+    the SAME elastic seam preemption uses, never lost or repeated
+    training."""
+    worker_env = _subprocess_env({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    base_out = str(tmp_path / "base.json")
+    base = subprocess.run(_demo_argv(base_out), env=worker_env,
+                          capture_output=True, timeout=300)
+    assert base.returncode == 0, base.stderr.decode(
+        errors="replace")[-3000:]
+    flaky = tmp_path / "flaky_worker.py"
+    flaky.write_text(FLAKY_WORKER)
+    out = str(tmp_path / "retried.json")
+    log_dir = str(tmp_path / "logs")
+    sched = Scheduler(1, tick_s=0.05, preempt=False,
+                      log_dir=log_dir).start()
+    try:
+        job = sched.submit(JobSpec(
+            name="flaky-train",
+            argv=[sys.executable, str(flaky),
+                  str(tmp_path / "marker"), out],
+            tenant="research", snapshot_dir=str(tmp_path / "snaps"),
+            env=worker_env, max_retries=2, retry_backoff_s=0.05))
+        states = sched.wait([job.id], timeout_s=480)
+    finally:
+        sched.stop(kill=True)
+
+    def _logs():
+        chunks = []
+        for name in sorted(os.listdir(log_dir)):
+            with open(os.path.join(log_dir, name), "rb") as f:
+                chunks.append("%s:\n%s" % (
+                    name, f.read().decode(errors="replace")[-3000:]))
+        return "\n".join(chunks)
+
+    assert states == {job.id: DONE}, _logs()
+    assert job.retries == 1, _logs()
+    assert job.grants == 2
+    assert "retrying 1/2" in (job.error or "")
+    assert json.load(open(out)) == json.load(open(base_out)), _logs()
 
 
 def test_scheduled_ensemble_trains_members_concurrently(tmp_path):

@@ -292,6 +292,37 @@ def test_batcher_validates_sample_shape(trained):
         pool.stop()
 
 
+def test_batcher_sheds_expired_deadline_at_dequeue(trained):
+    """ISSUE 20 satellite: a request whose client deadline passed
+    while it queued is dropped BEFORE compute — the future fails with
+    DeadlineExceeded, the shed is counted, and the admission slot is
+    settled (capacity never leaks)."""
+    from veles_tpu.serving.engine import DeadlineExceeded
+    model = ServeableModel.from_workflow(trained, name="m")
+    metrics = ServingMetrics()
+    pool = ReplicaPool(model, n_replicas=1, max_batch_size=4,
+                       warm=False)
+    batcher = DynamicBatcher(pool, batch_timeout_ms=5, max_queue=8,
+                             metrics=metrics)
+    try:
+        x = numpy.random.RandomState(3).rand(144).astype(numpy.float32)
+        expired = batcher.submit(x, deadline=time.time() - 0.5)
+        with pytest.raises(DeadlineExceeded, match="while queued"):
+            expired.result(timeout=30)
+        # a live deadline sails through untouched
+        live = batcher.submit(x, deadline=time.time() + 60.0)
+        assert live.result(timeout=30).shape == (10,)
+        snap = metrics.snapshot()
+        assert snap["deadline_shed_total"] == 1
+        deadline = time.monotonic() + 10.0
+        while batcher.queue_depth() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert batcher.queue_depth() == 0        # both slots settled
+    finally:
+        batcher.stop()
+        pool.stop()
+
+
 class _SlowModel(ServeableModel):
     """Each forward sleeps host-side so the queue can back up."""
 

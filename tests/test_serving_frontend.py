@@ -363,6 +363,69 @@ def test_sustained_overload_soak_never_deadlocks(model):
         fe.stop()
 
 
+def test_deadline_header_threads_through_and_sheds_as_504(
+        frontend, monkeypatch):
+    """ISSUE 20 satellite: ``X-Deadline-Ms`` (or body ``deadline_ms``)
+    becomes an absolute deadline at arrival and rides into
+    ``engine.submit``; a request shed in-queue surfaces as HTTP 504;
+    a non-positive or garbage value is a 400, not a crash."""
+    import concurrent.futures
+
+    from veles_tpu.serving.engine import DeadlineExceeded
+    engine = frontend.engine
+    seen = []
+    orig = engine.submit
+
+    def spy(sample, tenant=None, qos=None, deadline=None):
+        seen.append(deadline)
+        return orig(sample, tenant=tenant, qos=qos, deadline=deadline)
+
+    monkeypatch.setattr(engine, "submit", spy)
+    x = numpy.random.RandomState(9).rand(144).astype(numpy.float32)
+    payload = {"input": x.tolist(), "codec": "list"}
+
+    def _post_with(headers=None, body=None):
+        data = dict(payload)
+        data.update(body or {})
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api" % frontend.port,
+            data=json.dumps(data).encode("utf-8"),
+            headers=dict({"Content-Type": "application/json"},
+                         **(headers or {})))
+        try:
+            with urllib.request.urlopen(req, timeout=20) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    before = time.time()
+    status, _ = _post_with(headers={"X-Deadline-Ms": "60000"})
+    assert status == 200
+    assert before + 59.0 < seen[-1] < time.time() + 61.0
+    # body fallback when the header is absent
+    status, _ = _post_with(body={"deadline_ms": 30000})
+    assert status == 200
+    assert before + 29.0 < seen[-1] < time.time() + 31.0
+    # no deadline -> None (requests without a budget never shed)
+    status, _ = _post_with()
+    assert status == 200 and seen[-1] is None
+    # invalid budgets are rejected up front
+    for bad in ("-5", "0", "soon"):
+        status, reply = _post_with(headers={"X-Deadline-Ms": bad})
+        assert status == 400
+        assert "X-Deadline-Ms" in reply["error"]
+    # a queue-expired request surfaces as 504 (no Retry-After: the
+    # client's own budget, not our capacity, was exhausted)
+    shed = concurrent.futures.Future()
+    shed.set_exception(DeadlineExceeded(
+        "deadline passed 12 ms ago while queued"))
+    monkeypatch.setattr(engine, "submit",
+                        lambda *a, **kw: shed)
+    status, reply = _post_with(headers={"X-Deadline-Ms": "1"})
+    assert status == 504
+    assert "while queued" in reply["error"]
+
+
 def test_web_status_renders_serving_block(frontend):
     from veles_tpu.web_status import _STATUS_PAGE, WebStatusServer
     server = WebStatusServer(host="127.0.0.1", port=0).start()

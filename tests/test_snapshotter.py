@@ -457,6 +457,71 @@ def test_generation_missing_a_listed_part_falls_back(tmp_path):
         assert (got == want).all()
 
 
+def test_prune_keeps_last_k_complete_generations(tmp_path):
+    """ISSUE 20 satellite: keep-last-K retention removes only the
+    OLDEST complete generations — the newest ``keep`` survive, a
+    manifestless (mid-save) dir is never retention's business, and
+    ``keep < 1`` is rejected."""
+    from veles_tpu import snapshotter as snap
+    wf = build(max_epochs=1)
+    wf.run()
+    g_old = _save_generation(wf, tmp_path, "_g0", age_s=90)
+    g_mid = _save_generation(wf, tmp_path, "_g1", age_s=60)
+    g_new = _save_generation(wf, tmp_path, "_g2", age_s=30)
+    torn = tmp_path / "wf_gTORN.1.shards"
+    torn.mkdir()                      # no manifest: a save in flight
+    with pytest.raises(ValueError):
+        snap.prune_sharded_generations(str(tmp_path), keep=0)
+    removed = snap.prune_sharded_generations(str(tmp_path), keep=2)
+    assert removed == [g_old]
+    assert not os.path.exists(g_old)
+    assert os.path.exists(g_mid) and os.path.exists(g_new)
+    assert os.path.isdir(str(torn))
+    # idempotent: nothing left beyond the keep window
+    assert snap.prune_sharded_generations(str(tmp_path), keep=2) == []
+    # the survivors still restore
+    _, path = snap.restore_latest(str(tmp_path))
+    assert "_g2" in path
+
+
+def test_prune_never_removes_current_link_target(tmp_path):
+    """The restore point wins over age: whatever ``*_current.pickle``
+    resolves to is protected even when it falls outside the keep
+    window."""
+    from veles_tpu import snapshotter as snap
+    wf = build(max_epochs=1)
+    wf.run()
+    g_old = _save_generation(wf, tmp_path, "_g0", age_s=90)
+    g_mid = _save_generation(wf, tmp_path, "_g1", age_s=60)
+    g_new = _save_generation(wf, tmp_path, "_g2", age_s=30)
+    link = tmp_path / "wf_current.pickle"
+    os.symlink(os.path.basename(g_old), str(link))
+    removed = snap.prune_sharded_generations(str(tmp_path), keep=1)
+    assert removed == [g_mid]
+    assert os.path.exists(g_old)      # protected: the link's target
+    assert os.path.exists(g_new)      # protected: inside the window
+
+
+def test_snapshot_keep_knob_prunes_on_save(tmp_path, monkeypatch):
+    """``VELES_SNAPSHOT_KEEP`` wires retention into every sharded
+    save (process 0, after the manifest commit); unset or garbage
+    means keep-everything, exactly as before."""
+    from veles_tpu import snapshotter as snap
+    wf = build(max_epochs=1)
+    wf.run()
+    monkeypatch.setenv("VELES_SNAPSHOT_KEEP", "1")
+    g0 = _save_generation(wf, tmp_path, "_g0", age_s=60)
+    g1 = _save_generation(wf, tmp_path, "_g1")
+    assert not os.path.exists(g0)     # pruned by the g1 save
+    assert os.path.exists(g1)
+    monkeypatch.setenv("VELES_SNAPSHOT_KEEP", "bogus")
+    g2 = _save_generation(wf, tmp_path, "_g2")
+    assert os.path.exists(g1) and os.path.exists(g2)
+    monkeypatch.delenv("VELES_SNAPSHOT_KEEP")
+    g3 = _save_generation(wf, tmp_path, "_g3")
+    assert all(os.path.exists(g) for g in (g1, g2, g3))
+
+
 def test_manifestless_generation_is_never_a_candidate(tmp_path):
     """A generation whose writer died before the manifest commit is
     invisible to restores (and to latest_snapshot)."""

@@ -230,10 +230,12 @@ def test_serving_metrics_schema_unchanged():
     sm.record_batch(3, 8)
     snap = sm.snapshot()
     # additive since PR 3: "cached_total" counts requests answered
-    # from the result cache (ISSUE 14); every PR 3 key is untouched
+    # from the result cache (ISSUE 14) and "deadline_shed_total"
+    # counts expired-in-queue drops (ISSUE 20); every PR 3 key is
+    # untouched
     assert set(snap) == {"uptime_s", "model", "qps", "rejected_total",
-                         "cached_total", "endpoints", "batches",
-                         "queue_depth"}
+                         "cached_total", "deadline_shed_total",
+                         "endpoints", "batches", "queue_depth"}
     endpoint = snap["endpoints"]["/api"]
     assert set(endpoint) == {"requests", "responses", "qps", "p50_ms",
                              "p95_ms", "p99_ms"}

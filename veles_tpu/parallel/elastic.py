@@ -952,7 +952,20 @@ class _MetricsPusher(object):
     endpoint (``VELES_SCHED_METRICS_URL``, set by the scheduler in
     the gang env) every ``VELES_SCHED_METRICS_S`` seconds. Every
     failure is swallowed — the scheduler being down must never stall
-    or kill training."""
+    or kill training.
+
+    The feed survives a scheduler RESTART (ISSUE 20): consecutive
+    push failures back off with the fleet-wide jittered exponential
+    shape (never give up, never hot-spin a refused connection), and
+    the first successful push after an outage is a full resync — a
+    recovered scheduler has an empty federated view, and waiting for
+    its gap-detect ``{"resync": True}`` ack would heal one push later
+    than marking the resync ourselves."""
+
+    #: failure backoff bounds: base = one interval (min 0.25 s so a
+    #: very fast test interval still decays), cap well under the
+    #: scheduler's restart time scale
+    BACKOFF_CAP_S = 10.0
 
     def __init__(self, url, job, interval_s):
         from veles_tpu.telemetry.federation import SnapshotEncoder
@@ -960,6 +973,7 @@ class _MetricsPusher(object):
         self.job = job
         self.interval_s = interval_s
         self._encoder = SnapshotEncoder()
+        self._failures = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="sched-metrics-push")
@@ -969,7 +983,7 @@ class _MetricsPusher(object):
         import urllib.request
         delta = self._encoder.encode()
         if delta is None:
-            return
+            return False
         body = json.dumps({"job": self.job,
                            "telemetry": delta}).encode("utf-8")
         req = urllib.request.Request(
@@ -979,13 +993,30 @@ class _MetricsPusher(object):
             reply = json.loads(resp.read().decode("utf-8"))
         if reply.get("resync"):
             self._encoder.mark_resync()
+        return True
 
     def _loop(self):
-        while not self._stop.wait(self.interval_s):
+        from veles_tpu.parallel.retry import backoff_delay
+        wait = self.interval_s
+        while not self._stop.wait(wait):
             try:
-                self._push()
+                pushed = self._push()
             except Exception:
-                pass
+                # bounded jittered retry: exponent capped so the wait
+                # can't overflow, sleep capped at BACKOFF_CAP_S
+                self._failures += 1
+                wait = backoff_delay(
+                    min(self._failures - 1, 16),
+                    base_s=max(self.interval_s, 0.25),
+                    cap_s=self.BACKOFF_CAP_S)
+            else:
+                if pushed and self._failures:
+                    # back from an outage: the scheduler may have
+                    # restarted with an empty federated view — make
+                    # the next delta a full snapshot
+                    self._failures = 0
+                    self._encoder.mark_resync()
+                wait = self.interval_s
 
     def stop(self):
         self._stop.set()

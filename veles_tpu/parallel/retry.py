@@ -16,6 +16,14 @@ import random
 import time
 
 
+def backoff_delay(attempt, base_s=0.25, cap_s=10.0):
+    """The fleet-wide backoff shape as a single number: the jittered
+    sleep before retry ``attempt`` (0-based) — ``base_s * 2^attempt``
+    capped at ``cap_s``, scaled to 50-150 % so a restarting fleet
+    never retries in lockstep."""
+    return min(base_s * 2 ** attempt, cap_s) * (0.5 + random.random())
+
+
 def retry_with_backoff(attempt_fn, budget_s, *, base_s=0.25, cap_s=10.0,
                        retry_on=(ConnectionError, OSError),
                        give_up=None, describe="operation"):
@@ -31,7 +39,6 @@ def retry_with_backoff(attempt_fn, budget_s, *, base_s=0.25, cap_s=10.0,
     ``describe`` when the budget is exhausted.
     """
     deadline = time.monotonic() + max(budget_s, 0.0)
-    delay = base_s
     attempt = 0
     while True:
         try:
@@ -43,6 +50,5 @@ def retry_with_backoff(attempt_fn, budget_s, *, base_s=0.25, cap_s=10.0,
                 raise ConnectionError(
                     "%s after %d attempt(s): %s"
                     % (describe, attempt, e)) from e
-        sleep = min(delay, remaining) * (0.5 + random.random())
+        sleep = backoff_delay(attempt - 1, base_s, cap_s)
         time.sleep(min(sleep, max(remaining, 0.0)))
-        delay = min(delay * 2, cap_s)

@@ -8,9 +8,14 @@ dedicated to one job. This package packs many training jobs onto one
 device pool:
 
 * :mod:`veles_tpu.sched.job` — :class:`JobSpec` (workflow + config
-  overrides + tenant + QoS + elastic world-size range) and the job FSM
-  (``PENDING -> RUNNING -> PREEMPTED -> DONE/FAILED``), every
-  transition counted in ``veles_sched_*`` metric families;
+  overrides + tenant + QoS + elastic world-size range + retry budget)
+  and the job FSM (``PENDING -> RUNNING -> PREEMPTED/RETRYING ->
+  DONE/FAILED``), every transition counted in ``veles_sched_*``
+  metric families;
+* :mod:`veles_tpu.sched.journal` — the write-ahead job journal:
+  fsync'd JSONL events + compacted snapshots under ``--state-dir``,
+  replayed at restart so a scheduler crash loses nothing — surviving
+  gangs are adopted in place, dead ones resume from checkpoint;
 * :mod:`veles_tpu.sched.scheduler` — device-inventory pool, gang
   placement of contiguous mesh slices, weighted-fair per-tenant quotas
   through the shared :mod:`veles_tpu.fairshare` ledger, preemption =
@@ -26,12 +31,14 @@ device pool:
 """
 
 from veles_tpu.sched.job import (DONE, FAILED, PENDING, PREEMPTED,
-                                 RUNNING, Job, JobSpec)
+                                 RETRYING, RUNNING, Job, JobSpec)
+from veles_tpu.sched.journal import JobJournal
 from veles_tpu.sched.scheduler import (DevicePool, Scheduler,
                                        SchedulerControl)
 from veles_tpu.sched.tenants import (ScheduledEnsembleTrainManager,
                                      ScheduledGeneticsOptimizer)
 
-__all__ = ["JobSpec", "Job", "PENDING", "RUNNING", "PREEMPTED", "DONE",
-           "FAILED", "DevicePool", "Scheduler", "SchedulerControl",
+__all__ = ["JobSpec", "Job", "PENDING", "RUNNING", "PREEMPTED",
+           "RETRYING", "DONE", "FAILED", "DevicePool", "JobJournal",
+           "Scheduler", "SchedulerControl",
            "ScheduledGeneticsOptimizer", "ScheduledEnsembleTrainManager"]

@@ -17,6 +17,11 @@ deployment defaults)::
     VELES_SCHED_MIN_RUN_S  victim thrash guard seconds (default 1.0)
     VELES_SCHED_LOG_DIR    per-gang-member log directory (default:
                            inherit the scheduler's stdio)
+    VELES_SCHED_STATE_DIR  durable state directory — the write-ahead
+                           job journal + compacted snapshots live
+                           here; a restart on the same dir recovers
+                           every job and adopts surviving gangs
+                           (default: in-memory only)
 """
 
 import argparse
@@ -68,6 +73,10 @@ def _serve_main(argv):
                         help="victim must have run this long")
     parser.add_argument("--log-dir", default=None,
                         help="per-gang-member log files land here")
+    parser.add_argument("--state-dir", default=None, metavar="DIR",
+                        help="journal job state here and recover "
+                             "from it at startup (adopting gangs "
+                             "that survived the restart)")
     parser.add_argument("--status-url", default=None, metavar="URL",
                         help="web_status dashboard base URL to push "
                              "the jobs table to (e.g. "
@@ -85,14 +94,18 @@ def _serve_main(argv):
     min_run_s = args.min_run_s if args.min_run_s is not None else \
         env_knob("VELES_SCHED_MIN_RUN_S", 1.0, parse=float)
     log_dir = args.log_dir or env_knob("VELES_SCHED_LOG_DIR")
+    state_dir = args.state_dir or env_knob("VELES_SCHED_STATE_DIR")
 
     from veles_tpu.sched.scheduler import Scheduler, SchedulerControl
     host, port = _split_addr(addr)
     scheduler = Scheduler(pool, tick_s=tick_s, preempt=preempt,
-                          min_run_s=min_run_s, log_dir=log_dir)
+                          min_run_s=min_run_s, log_dir=log_dir,
+                          state_dir=state_dir)
+    # control first: clients get 503 + Retry-After during the replay
+    # window instead of a connection refusal
     control = SchedulerControl(scheduler, host=host, port=port)
-    scheduler.start()
     control.start()
+    scheduler.start()
     print("SCHED %s:%d pool=%d" % (control.address[0], control.port,
                                    pool), flush=True)
     try:
@@ -154,6 +167,12 @@ def _submit_main(argv):
                              "preemptible)")
     parser.add_argument("--result-file", default=None)
     parser.add_argument("-s", "--seed", type=int, default=None)
+    parser.add_argument("--max-retries", type=int, default=0,
+                        help="re-run a failed gang up to this many "
+                             "times (exponential backoff) before "
+                             "FAILED")
+    parser.add_argument("--retry-backoff-s", type=float, default=1.0,
+                        help="base backoff before a retry re-queues")
     parser.add_argument("--wait", action="store_true",
                         help="poll until the job is terminal; exit "
                              "0 only on DONE")
@@ -164,7 +183,9 @@ def _submit_main(argv):
             "weight": args.weight, "world_min": int(world_min),
             "world_max": int(world_max or world_min),
             "snapshot_dir": args.snapshots,
-            "result_file": args.result_file, "seed": args.seed}
+            "result_file": args.result_file, "seed": args.seed,
+            "max_retries": args.max_retries,
+            "retry_backoff_s": args.retry_backoff_s}
     if exec_argv:
         if args.spec:
             parser.error("give either workflow args or a `--` "

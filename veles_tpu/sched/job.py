@@ -6,17 +6,26 @@ elastic gang of ``world_min..world_max`` device slots. Its lifecycle
 is a small FSM::
 
     PENDING --> RUNNING --> DONE
-                  |  ^         \\-> FAILED
-                  v  |
-               PREEMPTED -------/
+                  |  ^ \\       \\-> FAILED
+                  v  |  \\------> RETRYING --> FAILED
+               PREEMPTED -------/   (budgeted, backoff)
 
 ``RUNNING -> PREEMPTED`` is checkpoint + shrink (the gang is killed;
 its last complete per-epoch sharded checkpoint is the resume point)
 and ``PREEMPTED -> RUNNING`` is re-form + reshard-on-restore — the
 PR 12/13 determinism contract makes the resumed loss curve
-bit-identical to an uninterrupted run. Every transition lands in the
-``veles_sched_transitions_total`` counter; terminal states also count
-into ``veles_sched_jobs_total``.
+bit-identical to an uninterrupted run. ``RUNNING -> RETRYING`` is the
+failure policy: a gang that exited nonzero with retry budget left
+(``JobSpec.max_retries``) re-queues after a jittered exponential
+backoff instead of landing in FAILED on the first strike. Every
+transition lands in the ``veles_sched_transitions_total`` counter;
+terminal states also count into ``veles_sched_jobs_total``.
+
+Jobs survive scheduler restarts: :meth:`Job.record` /
+:meth:`Job.from_record` round-trip the full job through the
+write-ahead journal (:mod:`veles_tpu.sched.journal`) without touching
+the metric counters — replay must not double-count what the live
+scheduler already counted.
 """
 
 import itertools
@@ -30,17 +39,19 @@ from veles_tpu.fairshare import DEFAULT_QOS, QOS_MULTIPLIER
 PENDING = "pending"
 RUNNING = "running"
 PREEMPTED = "preempted"
+RETRYING = "retrying"
 DONE = "done"
 FAILED = "failed"
 
-STATES = (PENDING, RUNNING, PREEMPTED, DONE, FAILED)
+STATES = (PENDING, RUNNING, PREEMPTED, RETRYING, DONE, FAILED)
 
 #: legal FSM moves; anything else is a scheduler bug, not a runtime
 #: condition — transition() raises instead of recording garbage
 TRANSITIONS = {
     PENDING: (RUNNING, FAILED),
-    RUNNING: (PREEMPTED, DONE, FAILED),
+    RUNNING: (PREEMPTED, RETRYING, DONE, FAILED),
     PREEMPTED: (RUNNING, FAILED),
+    RETRYING: (RUNNING, FAILED),
     DONE: (),
     FAILED: (),
 }
@@ -48,6 +59,14 @@ TRANSITIONS = {
 DEFAULT_TENANT = "default"
 
 _ids = itertools.count(1)
+
+
+def reserve_job_ids(floor):
+    """Advance the job-id mint past ``floor`` (an int) so ids recovered
+    from the journal and freshly minted ones never collide."""
+    global _ids
+    current = next(_ids)
+    _ids = itertools.count(max(floor + 1, current))
 
 
 def _metrics():
@@ -115,6 +134,26 @@ def _metrics():
             "veles_sched_job_loss_age_s",
             "Seconds since the job's loss last CHANGED (feeds "
             "job_loss_plateau)", labels=("job", "tenant")),
+        # durability plane (write-ahead journal + crash recovery)
+        "journal_bytes": r.gauge(
+            "veles_sched_journal_bytes",
+            "Current size of the scheduler's write-ahead journal "
+            "(sawtooths at each compaction)"),
+        "replays": r.counter(
+            "veles_sched_replays_total",
+            "Journal replays completed at scheduler start"),
+        "adopted": r.counter(
+            "veles_sched_gangs_adopted_total",
+            "Still-alive gangs re-attached (not killed) after a "
+            "scheduler restart"),
+        "retries": r.counter(
+            "veles_sched_job_retries_total",
+            "Failed gangs re-queued under the job's retry budget",
+            labels=("tenant",)),
+        "recovery_ms": r.histogram(
+            "veles_sched_recovery_ms",
+            "Restart recovery phase wall time",
+            labels=("phase",)),
     }
 
 
@@ -149,7 +188,8 @@ class JobSpec(object):
                  overrides=None, extra_argv=(), result_file=None,
                  seed=None, tenant=DEFAULT_TENANT, qos=DEFAULT_QOS,
                  weight=1.0, world_min=1, world_max=None,
-                 snapshot_dir=None, env=None):
+                 snapshot_dir=None, env=None, max_retries=0,
+                 retry_backoff_s=1.0):
         if (argv is None) == (workflow is None):
             raise ValueError(
                 "exactly one of argv / workflow must be given")
@@ -176,6 +216,14 @@ class JobSpec(object):
                                           self.world_max))
         self.snapshot_dir = snapshot_dir
         self.env = dict(env or {})
+        self.max_retries = int(max_retries)
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0 (got %d)"
+                             % self.max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        if self.retry_backoff_s < 0:
+            raise ValueError("retry_backoff_s must be >= 0 (got %s)"
+                             % self.retry_backoff_s)
 
     @property
     def preemptible(self):
@@ -211,6 +259,8 @@ class JobSpec(object):
             "weight": self.weight, "world_min": self.world_min,
             "world_max": self.world_max,
             "snapshot_dir": self.snapshot_dir, "env": self.env,
+            "max_retries": self.max_retries,
+            "retry_backoff_s": self.retry_backoff_s,
         }
 
     @classmethod
@@ -218,7 +268,7 @@ class JobSpec(object):
         known = ("name", "argv", "workflow", "config", "overrides",
                  "extra_argv", "result_file", "seed", "tenant", "qos",
                  "weight", "world_min", "world_max", "snapshot_dir",
-                 "env")
+                 "env", "max_retries", "retry_backoff_s")
         unknown = set(data) - set(known)
         if unknown:
             raise ValueError("unknown JobSpec fields %s"
@@ -254,15 +304,29 @@ class Job(object):
         self.granted_world = 0
         self.slots = ()
         self.procs = []
+        #: last grant's worker pids (== pgids: workers start their own
+        #: session) — what the journal records and recovery probes
+        self.pids = ()
         self.grants = 0                # ENV_GEN generation counter
         self.preemptions = 0
+        self.retries = 0               # failure-policy re-runs used
+        self.retry_at = None           # wall time the next run unlocks
+        self.failure_times = []        # crash-loop detection window
         self.error = None
         self.history = [(self.submitted_t, PENDING)]
         self._metrics = metrics if metrics is not None else _metrics()
 
     @property
     def runnable(self):
-        return self.state in (PENDING, PREEMPTED)
+        return self.state in (PENDING, PREEMPTED, RETRYING)
+
+    def ready(self, now=None):
+        """Runnable AND past any retry backoff hold."""
+        if not self.runnable:
+            return False
+        if self.retry_at is None:
+            return True
+        return (time.time() if now is None else now) >= self.retry_at
 
     @property
     def terminal(self):
@@ -280,6 +344,7 @@ class Job(object):
         self._metrics["transitions"].labels(
             tenant=self.spec.tenant, to=to).inc()
         if to == RUNNING:
+            self.retry_at = None
             if self.started_t is None:
                 self.started_t = now
                 self.queue_wait_s = now - self.submitted_t
@@ -295,6 +360,11 @@ class Job(object):
             self.preempted_t = time.perf_counter()
             self.runnable_since = now
             self._metrics["preemptions"].labels(
+                tenant=self.spec.tenant).inc()
+        elif to == RETRYING:
+            self.retries += 1
+            self.runnable_since = now
+            self._metrics["retries"].labels(
                 tenant=self.spec.tenant).inc()
         if to in (DONE, FAILED):
             self.finished_t = now
@@ -330,7 +400,71 @@ class Job(object):
             "finished_t": self.finished_t,
             "queue_wait_s": self.queue_wait_s,
             "preemptions": self.preemptions,
+            "retries": self.retries,
             "preempt_resume_s": self.preempt_resume_s,
             "metrics": self.live_view(),
             "error": self.error,
         }
+
+    def record(self):
+        """The journal image of this job: everything a restarted
+        scheduler needs to rebuild it exactly (upsert semantics — each
+        journaled event carries the FULL record, which is what makes
+        replay trivially idempotent)."""
+        return {
+            "id": self.id, "trace_id": self.trace_id,
+            "spec": self.spec.to_dict(), "state": self.state,
+            "submitted_t": self.submitted_t,
+            "runnable_since": self.runnable_since,
+            "started_t": self.started_t,
+            "finished_t": self.finished_t,
+            "queue_wait_s": self.queue_wait_s,
+            "preempt_resume_s": self.preempt_resume_s,
+            "granted_world": self.granted_world,
+            "slots": list(self.slots), "pids": list(self.pids),
+            "grants": self.grants, "preemptions": self.preemptions,
+            "retries": self.retries, "retry_at": self.retry_at,
+            "failure_times": list(self.failure_times),
+            "error": self.error,
+            "history": [list(h) for h in self.history],
+        }
+
+    @classmethod
+    def from_record(cls, record, metrics=None):
+        """Rebuild a journaled job WITHOUT walking the FSM — replay
+        must not re-count transitions/queue-wait/preemptions the live
+        scheduler already metered."""
+        job = cls.__new__(cls)
+        job.id = record["id"]
+        job.spec = JobSpec.from_dict(record["spec"])
+        job.trace_id = record["trace_id"]
+        job.state = record["state"]
+        if job.state not in STATES:
+            raise ValueError("journaled job %s has unknown state %r"
+                             % (job.id, job.state))
+        job.submitted_t = record["submitted_t"]
+        job.runnable_since = record.get("runnable_since",
+                                        job.submitted_t)
+        job.started_t = record.get("started_t")
+        job.finished_t = record.get("finished_t")
+        #: perf_counter spans are meaningless across processes — a
+        #: preemption in flight at crash time is re-timed from resume
+        job.preempted_t = None
+        job.preempt_resume_s = record.get("preempt_resume_s")
+        job.queue_wait_s = record.get("queue_wait_s")
+        job.live = {}
+        job.granted_world = record.get("granted_world", 0)
+        job.slots = tuple(record.get("slots") or ())
+        job.procs = []
+        job.pids = tuple(record.get("pids") or ())
+        job.grants = record.get("grants", 0)
+        job.preemptions = record.get("preemptions", 0)
+        job.retries = record.get("retries", 0)
+        job.retry_at = record.get("retry_at")
+        job.failure_times = list(record.get("failure_times") or ())
+        job.error = record.get("error")
+        job.history = [tuple(h) for h in (record.get("history") or ())]
+        if not job.history:
+            job.history = [(job.submitted_t, PENDING)]
+        job._metrics = metrics if metrics is not None else _metrics()
+        return job

@@ -25,8 +25,23 @@ packs submitted :class:`~veles_tpu.sched.job.Job` gangs onto it:
   resumed loss curve bit-identical to an uninterrupted run (the
   PR 12/13 invariant, proven at this tier by
   ``tests/test_sched.py::test_preempt_resume_loss_parity``);
-* a failed gang dumps a flight record (``sched_job_failed``) before
-  the job lands in FAILED.
+* a failed gang re-queues under its retry budget
+  (``JobSpec.max_retries`` with jittered exponential backoff and
+  crash-loop detection) and dumps a flight record
+  (``sched_job_failed``) before the job lands in FAILED.
+
+**Durability** (ISSUE 20): pass ``state_dir`` and every submit,
+transition, grant, preempt and reap is journaled through
+:class:`veles_tpu.sched.journal.JobJournal` before the caller sees
+the result. A restarting scheduler replays the journal, rebuilds
+jobs/accounts/pool holds, then reconciles reality: still-alive gangs
+(workers run in their own sessions, so they survive our death) are
+*adopted* in place via :class:`_AdoptedProc` — never killed — while
+dead gangs route through the preempt-style resume (preemptible) or
+the retry policy. PENDING/PREEMPTED jobs rejoin the queue with their
+original submit times, so queue-wait accounting and fair-share do not
+reset. The control surface answers 503 + Retry-After while replay is
+in flight.
 
 :class:`SchedulerControl` is the loopback HTTP surface the CLI talks
 to: ``POST /submit`` (a JobSpec dict), ``GET /status``,
@@ -58,8 +73,97 @@ from veles_tpu.parallel.elastic import (ENV_COORD, ENV_GEN, ENV_JOB,
                                         ENV_RANK, ENV_SNAPSHOTS,
                                         ENV_TENANT, ENV_TRACE,
                                         ENV_WORLD, _free_port)
+from veles_tpu.parallel.retry import backoff_delay
 from veles_tpu.sched.job import (DONE, FAILED, PENDING, PREEMPTED,
-                                 RUNNING, STATES, Job, _metrics)
+                                 RETRYING, RUNNING, STATES, Job,
+                                 _metrics, reserve_job_ids)
+from veles_tpu.sched.journal import JobJournal
+
+
+def _pid_alive(pid):
+    """Is ``pid`` still a live process? pidfd when the platform has
+    it (no pid-reuse race while the fd is held), signal-0 probe
+    otherwise."""
+    try:
+        opener = os.pidfd_open
+    except AttributeError:
+        opener = None
+    if opener is not None:
+        try:
+            os.close(opener(pid))
+        except ProcessLookupError:
+            return False
+        except OSError:
+            pass            # fall through to the portable probe
+        else:
+            return True
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+class _AdoptedProc(object):
+    """Popen-shaped handle for a gang member spawned by a PREVIOUS
+    scheduler process and adopted across a restart.
+
+    The member is NOT our child: init reaps it, so its real exit code
+    is unobservable. :meth:`poll` therefore reports ``0`` the moment
+    the process is gone — an adopted gang's exit is reaped as success
+    by design (a worker that actually failed leaves its own flight
+    records, and the job's result file tells the truth). Liveness
+    rides a pidfd held open from adoption time when available (immune
+    to pid reuse); otherwise the signal-0 probe."""
+
+    def __init__(self, pid):
+        self.pid = pid
+        self._pidfd = None
+        #: death is sticky: once observed dead, stay dead (the pidfd
+        #: is consumed by the first observation, and a later signal-0
+        #: probe could hit a reused pid — or an unreaped zombie)
+        self._dead = False
+        try:
+            self._pidfd = os.pidfd_open(pid)
+        except (AttributeError, OSError):
+            pass
+
+    def _alive(self):
+        if self._dead:
+            return False
+        if self._pidfd is not None:
+            import select
+            # the pidfd becomes readable when the process exits
+            ready, _, _ = select.select([self._pidfd], [], [], 0)
+            if not ready:
+                return True
+            os.close(self._pidfd)
+            self._pidfd = None
+        elif _pid_alive(self.pid):
+            return True
+        self._dead = True
+        return False
+
+    def poll(self):
+        return None if self._alive() else 0
+
+    def wait(self, timeout=None):
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while self._alive():
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired(
+                    "adopted-pid-%d" % self.pid, timeout)
+            time.sleep(0.05)
+        return 0
+
+    def kill(self):
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except OSError:
+            pass
 
 
 class DevicePool(object):
@@ -111,6 +215,28 @@ class DevicePool(object):
         self._held[job_id] = (best[0], want)
         return tuple(range(best[0], best[0] + want))
 
+    def hold(self, job_id, start, n):
+        """Re-impose a journaled grant verbatim (recovery path): the
+        exact ``(start, n)`` interval, validated against the pool
+        bounds and every other hold — a collision means the journal
+        and reality disagree, which must surface, not silently
+        fragment."""
+        start, n = int(start), int(n)
+        if job_id in self._held:
+            raise ValueError("%s already holds slots" % job_id)
+        if n < 1 or start < 0 or start + n > self.size:
+            raise ValueError(
+                "hold [%d, %d) is outside the pool of %d"
+                % (start, start + n, self.size))
+        for other, (o_start, o_n) in self._held.items():
+            if start < o_start + o_n and o_start < start + n:
+                raise ValueError(
+                    "hold [%d, %d) for %s overlaps %s at [%d, %d)"
+                    % (start, start + n, job_id, other, o_start,
+                       o_start + o_n))
+        self._held[job_id] = (start, n)
+        return tuple(range(start, start + n))
+
     def release(self, job_id):
         self._held.pop(job_id, None)
 
@@ -120,7 +246,8 @@ class Scheduler(Logger):
 
     def __init__(self, pool_size, tick_s=0.2, preempt=True,
                  min_run_s=1.0, activity_window_s=10.0, python=None,
-                 log_dir=None):
+                 log_dir=None, state_dir=None, crash_loop_k=3,
+                 crash_loop_window_s=60.0):
         super(Scheduler, self).__init__()
         self.pool = DevicePool(pool_size)
         self.tick_s = float(tick_s)
@@ -133,11 +260,25 @@ class Scheduler(Logger):
         self.activity_window_s = float(activity_window_s)
         self.python = python or sys.executable
         self.log_dir = log_dir
+        #: crash-loop tripwire: this many failures inside the window
+        #: overrides any remaining retry budget (a gang dying in a
+        #: tight loop is a bug, not a transient)
+        self.crash_loop_k = int(crash_loop_k)
+        self.crash_loop_window_s = float(crash_loop_window_s)
         self._lock = threading.RLock()
         self._jobs = {}        # id -> Job (insertion = submission order)
         self._accounts = {}    # tenant -> ShareAccount
         self._grant_seq = 0
         self._metrics = _metrics()
+        self._journal = None
+        #: the control surface answers 503 while this is True; set
+        #: from construction until recover() finishes so requests
+        #: racing the replay never see half-rebuilt state
+        self.recovering = False
+        if state_dir:
+            self._journal = JobJournal(state_dir,
+                                       metrics=self._metrics)
+            self.recovering = True
         #: per-job federation feeds (sid = job id), fed by POST
         #: /telemetry from each gang's rank-0 metrics pusher; lazy so
         #: a push-less scheduler never mints the federation families
@@ -161,11 +302,167 @@ class Scheduler(Logger):
             self._jobs[job.id] = job
             account = self._account(spec.tenant, spec)
             account.last_active = now
+            self._journal_event("submit", job, now)
             self.info("submitted %s (%s): tenant=%s qos=%s world=%d..%d"
                       "%s", job.id, spec.name, spec.tenant, spec.qos,
                       spec.world_min, spec.world_max,
                       " preemptible" if spec.preemptible else "")
         return job
+
+    # -- durability --------------------------------------------------------
+
+    def _journal_event(self, ev, job, now, **extra):
+        """One durable upsert line: the event name is decoration for
+        humans; the job's FULL record is the payload (what makes
+        replay idempotent). Compacts when the journal is over size."""
+        if self._journal is None:
+            return
+        event = {"ev": ev, "t": now, "grant_seq": self._grant_seq,
+                 "job": job.record()}
+        account = self._accounts.get(job.spec.tenant)
+        if account is not None:
+            event["account"] = {
+                "tenant": account.name, "weight": account.weight,
+                "qos": account.qos,
+                "admitted_total": account.admitted_total}
+        event.update(extra)
+        self._journal.append(event)
+        if self._journal.should_compact():
+            self._journal.compact(self._image_locked())
+
+    def _image_locked(self):
+        """The compacted journal snapshot: full scheduler state."""
+        return {
+            "grant_seq": self._grant_seq,
+            "jobs": [j.record() for j in self._jobs.values()],
+            "accounts": {
+                a.name: {"tenant": a.name, "weight": a.weight,
+                         "qos": a.qos,
+                         "admitted_total": a.admitted_total}
+                for a in self._accounts.values()},
+        }
+
+    def recover(self, now=None):
+        """Replay the journal and reconcile against reality. Runs
+        once, synchronously, before the tick loop — the control
+        surface 503s until it returns."""
+        if self._journal is None:
+            return self
+        now = time.time() if now is None else now
+        try:
+            with self._lock:
+                t0 = time.perf_counter()
+                image, events = self._journal.replay()
+                self._replay_locked(image, events, now)
+                self._metrics["recovery_ms"].labels(
+                    phase="replay").observe(
+                        (time.perf_counter() - t0) * 1e3)
+                self._metrics["replays"].inc()
+                self._reconcile_locked(now)
+                # fold everything just replayed into one fresh image
+                # so the NEXT restart replays a snapshot, not history
+                self._journal.compact(self._image_locked())
+        finally:
+            self.recovering = False
+        return self
+
+    def _replay_locked(self, image, events, now):
+        records = {}
+        accounts = {}
+        grant_seq = 0
+        if image:
+            grant_seq = int(image.get("grant_seq") or 0)
+            for record in image.get("jobs") or ():
+                if isinstance(record, dict) and "id" in record:
+                    records[record["id"]] = record
+            for name, info in (image.get("accounts") or {}).items():
+                accounts[name] = info
+        for event in events:
+            record = event.get("job")
+            if isinstance(record, dict) and "id" in record:
+                # upsert keeps the FIRST-insert position: submission
+                # order survives replay, which the fair queue needs
+                records[record["id"]] = record
+            grant_seq = max(grant_seq,
+                            int(event.get("grant_seq") or 0))
+            info = event.get("account")
+            if isinstance(info, dict) and info.get("tenant"):
+                accounts[info["tenant"]] = info
+        floor = 0
+        for record in records.values():
+            try:
+                job = Job.from_record(record, metrics=self._metrics)
+            except (KeyError, TypeError, ValueError) as e:
+                self.warning("dropping unreadable journaled job "
+                             "%r: %s", record.get("id"), e)
+                continue
+            self._jobs[job.id] = job
+            suffix = job.id.rsplit("-", 1)[-1]
+            if suffix.isdigit():
+                floor = max(floor, int(suffix))
+        reserve_job_ids(floor)
+        self._grant_seq = grant_seq
+        for job in self._jobs.values():
+            account = self._account(job.spec.tenant, job.spec)
+            account.last_active = max(
+                account.last_active, job.submitted_t,
+                job.started_t or 0.0, job.finished_t or 0.0)
+            if job.finished_t is not None:
+                account.completions.append(job.finished_t)
+            if job.state == RUNNING and job.slots:
+                account.outstanding += job.granted_world
+                self.pool.hold(job.id, job.slots[0],
+                               len(job.slots))
+        for name, info in accounts.items():
+            account = self._account(name)
+            account.weight = float(info.get("weight",
+                                            account.weight))
+            account.qos = info.get("qos", account.qos)
+            account.admitted_total = int(
+                info.get("admitted_total", account.admitted_total))
+        self.info("journal replay: %d job(s), %d account(s), "
+                  "grant_seq=%d", len(self._jobs),
+                  len(self._accounts), self._grant_seq)
+
+    def _reconcile_locked(self, now):
+        """Journal state vs reality: adopt gangs that survived our
+        death, route dead ones through resume/retry."""
+        t0 = time.perf_counter()
+        running = [j for j in self._jobs.values()
+                   if j.state == RUNNING]
+        alive = {job.id: bool(job.pids) and
+                 all(_pid_alive(pid) for pid in job.pids)
+                 for job in running}
+        self._metrics["recovery_ms"].labels(phase="probe").observe(
+            (time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        for job in running:
+            if alive[job.id]:
+                job.procs = [_AdoptedProc(pid) for pid in job.pids]
+                self._metrics["adopted"].inc()
+                self._journal_event("adopt", job, now)
+                self.info("%s: adopted surviving gang (pids %s)",
+                          job.id, list(job.pids))
+                continue
+            # the gang died while we were down: some members may
+            # still linger — take the remains down before re-placing
+            for pid in job.pids:
+                try:
+                    os.killpg(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            self._release_locked(job, now)
+            if job.spec.preemptible:
+                job.transition(PREEMPTED, now)
+                self._journal_event("recover", job, now)
+                self.info("%s: gang died while scheduler was down — "
+                          "resuming from checkpoint", job.id)
+            else:
+                self._fail_or_retry_locked(
+                    job, now,
+                    "gang died while scheduler was down")
+        self._metrics["recovery_ms"].labels(phase="adopt").observe(
+            (time.perf_counter() - t0) * 1e3)
 
     def _account(self, tenant, spec=None):
         account = self._accounts.get(tenant)
@@ -228,27 +525,62 @@ class Scheduler(Logger):
                 # heading into) a dead collective — take the gang down
                 self._kill_gang(job)
                 self._release_locked(job, now)
-                job.error = "worker exited rc=%s" % (
-                    [c for c in codes if c not in (None, 0)][0],)
-                job.transition(FAILED, now)
-                self._drop_job_view_locked(job)
-                self.warning("%s failed: %s", job.id, job.error)
-                from veles_tpu.telemetry.flight import get_recorder
-                get_recorder().dump("sched_job_failed",
-                                    job=job.to_dict(), rc=codes,
-                                    trace_id=job.trace_id)
+                rc = [c for c in codes if c not in (None, 0)][0]
+                self._fail_or_retry_locked(
+                    job, now, "worker exited rc=%s" % (rc,),
+                    rc=codes)
             elif all(code == 0 for code in codes):
                 self._release_locked(job, now)
                 job.transition(DONE, now)
+                self._journal_event("reap", job, now, rc=0)
                 self._drop_job_view_locked(job)
                 self.info("%s done (world=%d, %d preemption%s)",
                           job.id, job.granted_world, job.preemptions,
                           "" if job.preemptions == 1 else "s")
 
+    def _fail_or_retry_locked(self, job, now, error, rc=None):
+        """The failure policy: re-queue with backoff while retry
+        budget remains, UNLESS the gang is crash-looping
+        (``crash_loop_k`` failures inside ``crash_loop_window_s``) —
+        a tight failure loop is a bug to surface, not a transient to
+        absorb. Terminal failures dump the correlated
+        ``sched_job_failed`` flight record."""
+        job.failure_times.append(now)
+        cutoff = now - self.crash_loop_window_s
+        job.failure_times = [t for t in job.failure_times
+                             if t >= cutoff]
+        crash_loop = len(job.failure_times) >= self.crash_loop_k
+        if not crash_loop and job.retries < job.spec.max_retries:
+            job.error = "%s (retrying %d/%d)" % (
+                error, job.retries + 1, job.spec.max_retries)
+            job.transition(RETRYING, now)
+            job.retry_at = now + backoff_delay(
+                job.retries - 1, base_s=job.spec.retry_backoff_s)
+            self._journal_event("reap", job, now, rc=rc)
+            self.warning("%s: %s — retry %d/%d in %.2fs", job.id,
+                         error, job.retries, job.spec.max_retries,
+                         job.retry_at - now)
+            return
+        if crash_loop:
+            error = "%s (crash loop: %d failures in %.0fs)" % (
+                error, len(job.failure_times),
+                self.crash_loop_window_s)
+        job.error = error
+        job.transition(FAILED, now)
+        self._journal_event("reap", job, now, rc=rc)
+        self._drop_job_view_locked(job)
+        self.warning("%s failed: %s", job.id, job.error)
+        from veles_tpu.telemetry.flight import get_recorder
+        get_recorder().dump("sched_job_failed", job=job.to_dict(),
+                            rc=rc, retries=job.retries,
+                            failures=list(job.failure_times),
+                            trace_id=job.trace_id)
+
     def _schedule_locked(self, now):
         # resumes first (a preempted job already earned its slot once),
-        # oldest-runnable first within each class
-        runnable = [j for j in self._jobs.values() if j.runnable]
+        # oldest-runnable first within each class; ready() keeps a
+        # RETRYING job parked until its backoff hold expires
+        runnable = [j for j in self._jobs.values() if j.ready(now)]
         runnable.sort(key=lambda j: (j.state != PREEMPTED,
                                      j.runnable_since))
         for job in runnable:
@@ -278,16 +610,21 @@ class Scheduler(Logger):
             slots = self.pool.allocate(job.id, want)
             if slots is None:
                 continue
-            try:
-                self._spawn_locked(job, slots, now)
-            except OSError as e:
-                self.pool.release(job.id)
-                job.error = "spawn failed: %s" % e
-                job.transition(FAILED, now)
-                return False
+            # account BEFORE the spawn journals its "grant" event, so
+            # the journaled ledger matches the grant it rides with
             account.outstanding += want
             account.admitted_total += want
             account.last_active = now
+            try:
+                self._spawn_locked(job, slots, now)
+            except OSError as e:
+                account.outstanding -= want
+                account.admitted_total -= want
+                self.pool.release(job.id)
+                job.error = "spawn failed: %s" % e
+                job.transition(FAILED, now)
+                self._journal_event("spawn_failed", job, now)
+                return False
             return True
         return False
 
@@ -331,6 +668,7 @@ class Scheduler(Logger):
         self._kill_gang(victim)
         self._release_locked(victim, now)
         victim.transition(PREEMPTED, now)
+        self._journal_event("preempt", victim, now)
         return True
 
     # -- gang lifecycle ----------------------------------------------------
@@ -382,7 +720,9 @@ class Scheduler(Logger):
         job.slots = slots
         job.granted_world = world
         job.procs = procs
+        job.pids = tuple(proc.pid for proc in procs)
         job.transition(RUNNING, now)
+        self._journal_event("grant", job, now)
         self.info("%s: granted slots %s (world=%d, grant #%d)",
                   job.id, list(slots), world, job.grants)
 
@@ -418,6 +758,7 @@ class Scheduler(Logger):
         job.slots = ()
         job.granted_world = 0
         job.procs = []
+        job.pids = ()
 
     # -- telemetry ---------------------------------------------------------
 
@@ -615,7 +956,9 @@ class Scheduler(Logger):
     # -- lifecycle ---------------------------------------------------------
 
     def start(self):
-        """Run the tick loop on a daemon thread."""
+        """Recover from the journal (when configured), then run the
+        tick loop on a daemon thread."""
+        self.recover()
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="sched-tick")
@@ -638,13 +981,17 @@ class Scheduler(Logger):
             self._thread = None
         if kill:
             with self._lock:
+                now = time.time()
                 for job in self._jobs.values():
                     if job.state == RUNNING:
                         self._kill_gang(job)
-                        self._release_locked(job, time.time())
+                        self._release_locked(job, now)
                         job.error = "scheduler stopped"
-                        job.transition(FAILED)
+                        job.transition(FAILED, now)
+                        self._journal_event("stop", job, now)
                         self._drop_job_view_locked(job)
+        if self._journal is not None:
+            self._journal.close()
 
 
 class _ControlHandler(BaseHTTPRequestHandler):
@@ -653,13 +1000,24 @@ class _ControlHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         self.server.owner.debug("http: " + fmt, *args)
 
-    def _reply(self, body, code=200):
+    def _reply(self, body, code=200, headers=None):
         data = json.dumps(body).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(data)
+
+    def _recovering(self, scheduler):
+        """503 + Retry-After while journal replay is in flight — the
+        state a client would read is not rebuilt yet."""
+        if not scheduler.recovering:
+            return False
+        self._reply({"error": "scheduler is recovering, retry"},
+                    code=503, headers={"Retry-After": "1"})
+        return True
 
     def _reply_text(self, body, content_type="text/plain"):
         data = body.encode("utf-8")
@@ -671,6 +1029,8 @@ class _ControlHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         scheduler = self.server.owner.scheduler
+        if self._recovering(scheduler):
+            return
         if self.path.startswith("/status"):
             self._reply(scheduler.stats())
         elif self.path.startswith("/jobs.json"):
@@ -696,6 +1056,8 @@ class _ControlHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         scheduler = self.server.owner.scheduler
+        if self._recovering(scheduler):
+            return
         if self.path.startswith("/telemetry"):
             try:
                 length = int(self.headers.get("Content-Length", 0))

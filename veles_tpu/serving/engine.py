@@ -46,18 +46,26 @@ class EngineOverloaded(Exception):
         self.retry_after = int(retry_after)
 
 
+class DeadlineExceeded(Exception):
+    """The request's client deadline passed while it queued — shed at
+    dequeue, before any compute (HTTP 504)."""
+
+
 class _Request(object):
     __slots__ = ("sample", "future", "enqueued_at", "tenant",
-                 "cache_key", "cache_token")
+                 "cache_key", "cache_token", "deadline")
 
     def __init__(self, sample, tenant=None, cache_key=None,
-                 cache_token=None):
+                 cache_token=None, deadline=None):
         self.sample = sample
         self.future = concurrent.futures.Future()
         self.enqueued_at = time.time()
         self.tenant = tenant
         self.cache_key = cache_key
         self.cache_token = cache_token
+        #: absolute wall time (or None): past it, nobody is waiting
+        #: for the answer any more
+        self.deadline = deadline
 
 
 class DynamicBatcher(Logger):
@@ -96,10 +104,13 @@ class DynamicBatcher(Logger):
 
     # -- request side ------------------------------------------------------
 
-    def submit(self, sample, tenant=None, qos=None):
+    def submit(self, sample, tenant=None, qos=None, deadline=None):
         """One sample in, one Future out; EngineOverloaded when the
         tenant's share (or the engine) is full. A cache hit resolves
-        immediately — no admission, no batch, no forward."""
+        immediately — no admission, no batch, no forward.
+        ``deadline`` (absolute wall time) marks the moment the caller
+        stops waiting: a request still queued past it is shed at
+        dequeue with :class:`DeadlineExceeded` instead of computed."""
         sample = numpy.ascontiguousarray(sample, numpy.float32)
         model = self.pool.model
         expected = model.sample_shape
@@ -129,7 +140,7 @@ class DynamicBatcher(Logger):
         # the same bucket or outstanding counts leak)
         tenant = self.admission.admit(tenant, qos=qos)
         request = _Request(sample, tenant=tenant, cache_key=cache_key,
-                           cache_token=cache_token)
+                           cache_token=cache_token, deadline=deadline)
         self._queue.put(request)
         if self._stop.is_set():
             # stop() may have drained the queue between the check above
@@ -183,9 +194,32 @@ class DynamicBatcher(Logger):
                     break
         return batch
 
+    def _shed_expired(self, requests):
+        """Drop entries whose client deadline already passed — at
+        dequeue, BEFORE any compute: a stalled queue degrades by
+        shedding stale work, not by computing answers nobody is
+        waiting for. Returns the still-live remainder."""
+        now = time.time()
+        live = []
+        for r in requests:
+            if r.deadline is not None and now > r.deadline:
+                self.admission.settle(r.tenant)
+                if not r.future.done():
+                    r.future.set_exception(DeadlineExceeded(
+                        "deadline passed %.0f ms ago while queued"
+                        % ((now - r.deadline) * 1000.0)))
+                if self.metrics is not None:
+                    self.metrics.record_deadline_shed()
+            else:
+                live.append(r)
+        return live
+
     def _batch_loop(self):
         while not self._stop.is_set():
             requests = self._collect()
+            if not requests:
+                continue
+            requests = self._shed_expired(requests)
             if not requests:
                 continue
             batch = numpy.stack([r.sample for r in requests])

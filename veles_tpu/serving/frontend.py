@@ -64,7 +64,8 @@ from veles_tpu.serving.admission import (QOS_MULTIPLIER,
                                          AdmissionController)
 from veles_tpu.serving.autoscale import Autoscaler
 from veles_tpu.serving.cache import ResultCache
-from veles_tpu.serving.engine import DynamicBatcher, EngineOverloaded
+from veles_tpu.serving.engine import (DeadlineExceeded, DynamicBatcher,
+                                      EngineOverloaded)
 from veles_tpu.serving.metrics import ServingMetrics
 from veles_tpu.serving.model_store import ModelStore
 from veles_tpu.serving.replica import ReplicaPool
@@ -167,6 +168,7 @@ class ServingFrontend(Logger):
                  max_queue=256, response_timeout=30.0, warm=True,
                  cache_mb=64, cache_ttl_s=300.0, tenants=None,
                  tenant_header="X-Tenant", qos_header="X-QoS",
+                 deadline_header="X-Deadline-Ms",
                  min_replicas=None, max_replicas=None,
                  autoscale_interval_s=0.5, store=None,
                  keep_last=None):
@@ -176,6 +178,7 @@ class ServingFrontend(Logger):
         self.response_timeout = float(response_timeout)
         self.tenant_header = tenant_header
         self.qos_header = qos_header
+        self.deadline_header = deadline_header
         self.entries = {}
         if isinstance(model, dict):
             specs = list(model.items())
@@ -497,6 +500,25 @@ class ServingFrontend(Logger):
                        % (qos, sorted(QOS_MULTIPLIER)), rid=rid, t0=t0,
                        entry=entry)
             return
+        deadline_ms = handler.headers.get(self.deadline_header) or \
+            (request.get("deadline_ms") if isinstance(request, dict)
+             else None)
+        deadline = None
+        if deadline_ms is not None:
+            try:
+                deadline_ms = float(deadline_ms)
+                if deadline_ms <= 0:
+                    raise ValueError(deadline_ms)
+            except (TypeError, ValueError):
+                self._fail(handler, endpoint,
+                           "Invalid %s value %r (positive "
+                           "milliseconds)" % (self.deadline_header,
+                                              deadline_ms),
+                           rid=rid, t0=t0, entry=entry)
+                return
+            # relative budget -> absolute wall deadline at ARRIVAL:
+            # queue time spends the same budget compute would
+            deadline = t0 + deadline_ms / 1000.0
         # request-id → trace-id bridge: the span for this request (and
         # everything under it) carries the client's X-Request-Id / "id"
         trace_id = tracing.trace_id_from_request(handler.headers, rid)
@@ -505,24 +527,25 @@ class ServingFrontend(Logger):
                                       trace_id=trace_id):
                 if batched:
                     self._serve_batch(handler, entry, endpoint, request,
-                                      rid, t0, tenant, qos)
+                                      rid, t0, tenant, qos, deadline)
                 else:
                     self._serve_one(handler, entry, endpoint, request,
-                                    rid, t0, tenant, qos)
+                                    rid, t0, tenant, qos, deadline)
         except EngineOverloaded as e:
             self._fail(handler, endpoint, str(e), code=503, rid=rid,
                        headers={"Retry-After": str(e.retry_after)},
                        t0=t0, entry=entry)
 
     def _serve_one(self, handler, entry, endpoint, request, rid, t0,
-                   tenant, qos):
+                   tenant, qos, deadline=None):
         data, error = parse_payload(request)
         if error is not None:
             self._fail(handler, endpoint, error, rid=rid, t0=t0,
                        entry=entry)
             return
         try:
-            future = entry.engine.submit(data, tenant=tenant, qos=qos)
+            future = entry.engine.submit(data, tenant=tenant, qos=qos,
+                                         deadline=deadline)
         except ValueError as e:
             self._fail(handler, endpoint, "Invalid input value: %s" % e,
                        rid=rid, t0=t0, entry=entry)
@@ -531,7 +554,7 @@ class ServingFrontend(Logger):
                               t0, single=True)
 
     def _serve_batch(self, handler, entry, endpoint, request, rid, t0,
-                     tenant, qos):
+                     tenant, qos, deadline=None):
         if not isinstance(request, dict) or "codec" not in request or \
                 ("inputs" not in request and "input" not in request):
             self._fail(handler, endpoint, "Invalid input format: there "
@@ -575,8 +598,8 @@ class ServingFrontend(Logger):
         futures = []
         try:
             for row in rows:
-                futures.append(entry.engine.submit(row, tenant=tenant,
-                                                   qos=qos))
+                futures.append(entry.engine.submit(
+                    row, tenant=tenant, qos=qos, deadline=deadline))
         except ValueError as e:
             # rows already admitted still complete; their results are
             # simply dropped with the failed request
@@ -597,6 +620,10 @@ class ServingFrontend(Logger):
             self._fail(handler, endpoint,
                        "The model did not respond in time", code=500,
                        rid=rid, t0=t0, entry=entry)
+            return
+        except DeadlineExceeded as e:
+            self._fail(handler, endpoint, str(e), code=504, rid=rid,
+                       t0=t0, entry=entry)
             return
         except EngineOverloaded:
             raise

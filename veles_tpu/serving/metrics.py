@@ -77,6 +77,7 @@ class ServingMetrics(object):
         self._endpoints = {}
         self._rejected = 0          # admission-control 503s
         self._cached = 0            # requests answered from the cache
+        self._deadline_shed = 0     # expired-in-queue drops (504)
         self._batches = 0
         self._batch_rows = 0
         self._batch_capacity = 0    # sum of bucket sizes actually run
@@ -119,6 +120,11 @@ class ServingMetrics(object):
             "veles_serving_queue_depth",
             "Live admission-queue depth (refreshed on snapshot)",
             labels=("model",)).labels(model=self.model_label)
+        self._m_deadline_shed = registry.counter(
+            "veles_serving_deadline_shed_total",
+            "Requests shed at dequeue because their client deadline "
+            "had already passed (no compute spent)",
+            labels=("model",)).labels(**label)
 
     # -- wiring ------------------------------------------------------------
 
@@ -138,6 +144,12 @@ class ServingMetrics(object):
         """A request was answered from the result cache (no batch)."""
         with self._lock:
             self._cached += 1
+
+    def record_deadline_shed(self):
+        """A queued request expired before compute and was dropped."""
+        with self._lock:
+            self._deadline_shed += 1
+        self._m_deadline_shed.inc()
 
     def set_model(self, name, version):
         with self._lock:
@@ -193,6 +205,7 @@ class ServingMetrics(object):
                 "qps": total_qps,
                 "rejected_total": self._rejected,
                 "cached_total": self._cached,
+                "deadline_shed_total": self._deadline_shed,
                 "endpoints": per_endpoint,
                 "batches": {
                     "count": self._batches,

@@ -403,6 +403,48 @@ def snapshot_candidates(directory, prefix=None):
     return rest
 
 
+def prune_sharded_generations(directory, keep, prefix="wf"):
+    """Keep-last-``keep`` retention for sharded generation dirs
+    (mirrors ``ModelStore``'s keep-last-K semantics at the checkpoint
+    tier). Only COMPLETE generations (manifest present) are ever
+    candidates — a torn dir is a mid-save in progress, not garbage —
+    and the newest ``keep`` survive, so the restore point and the
+    generation being cut are never touched. Targets of any
+    ``*_current.pickle*`` link are protected regardless of age.
+    Returns the paths removed."""
+    import shutil
+    keep = int(keep)
+    if keep < 1:
+        raise ValueError("keep must be >= 1 (got %d)" % keep)
+    protected = set()
+    generations = []
+    for name in os.listdir(directory):
+        path = os.path.join(directory, name)
+        if "_current.pickle" in name:
+            protected.add(os.path.realpath(path))
+            continue
+        if name.startswith(".") or name.endswith(".tmp"):
+            continue
+        if not name.endswith(SHARDED_SUFFIX) or not os.path.isdir(path):
+            continue
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        if not os.path.exists(os.path.join(path, MANIFEST_NAME)):
+            continue   # torn or in-flight: never retention's business
+        generations.append(path)
+    generations.sort(key=_candidate_mtime, reverse=True)
+    removed = []
+    for path in generations[keep:]:
+        if os.path.realpath(path) in protected:
+            continue
+        try:
+            shutil.rmtree(path)
+        except OSError:
+            continue   # racing another pruner / a late reader: skip
+        removed.append(path)
+    return removed
+
+
 def latest_snapshot(directory, prefix=None):
     """Newest snapshot in a :class:`SnapshotterToFile` directory.
 
@@ -679,6 +721,13 @@ def save_snapshot_sharded(workflow, directory, records, *,
                 os.symlink(name, link_path)
             except OSError:
                 pass  # filesystems without symlinks
+        # retention AFTER the manifest commit: the generation just
+        # cut is complete (and newest), so it can never be a victim
+        from veles_tpu.envknob import env_knob
+        keep = env_knob("VELES_SNAPSHOT_KEEP", None, parse=int,
+                        on_error="default")
+        if keep is not None and keep >= 1:
+            prune_sharded_generations(directory, keep, prefix=prefix)
     return gen_dir, nbytes
 
 
